@@ -1,0 +1,59 @@
+#!/usr/bin/env python3
+"""Entry point — the reference CLI surface, unchanged (SURVEY.md §2 L6):
+
+  python3 code2vec.py --data <prefix> --test <file> --save/--load <ckpt>
+      [--predict] [--release] [--export_code_vectors]
+      [--save_w2v <p>] [--save_t2v <p>] [--framework jax] [--backend tpu]
+
+Dispatch order mirrors the reference `code2vec.py.__main__`: train if
+--data, release if --release, w2v/t2v export if requested, predict REPL if
+--predict, else evaluate if --test.
+"""
+
+import sys
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models.jax_model import Code2VecModel
+from code2vec_tpu.serving.interactive_predict import InteractivePredictor
+from code2vec_tpu.vocab.vocabularies import VocabType
+
+
+def main() -> int:
+    try:
+        config = Config.load_from_args()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    model = Code2VecModel(config)
+    config.log(f"model loaded: framework=jax backend={config.BACKEND}")
+
+    if config.release:
+        model.release()
+        return 0
+
+    if config.is_training:
+        model.train()
+
+    if config.save_w2v:
+        model.save_word2vec_format(config.save_w2v, VocabType.Token)
+        config.log(f"token embeddings (w2v format) -> {config.save_w2v}")
+    if config.save_t2v:
+        model.save_word2vec_format(config.save_t2v, VocabType.Target)
+        config.log(f"target embeddings (w2v format) -> {config.save_t2v}")
+
+    if config.is_predict:
+        InteractivePredictor(config, model).predict()
+    elif config.is_testing and not config.is_training:
+        results = model.evaluate()
+        print(str(results))
+        if config.export_code_vectors:
+            dest = config.test_data_path + ".vectors"
+            model.export_code_vectors_file(config.test_data_path, dest)
+            config.log(f"code vectors -> {dest}")
+
+    model.close_session()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""code2vec-tpu: a TPU-native framework for learning distributed
+representations of code from AST path-contexts.
+
+Re-implements the full capability surface of the reference
+(noamyft/code2vec — see SURVEY.md; mount was empty so SURVEY.md is the
+behavior contract, cited by section): path-context extraction (native C++
+instead of the reference JavaExtractor JVM component), offline preprocessing
+(`.c2v` / `.dict.c2v` interchange formats, SURVEY.md §3.2), a jit-compiled
+JAX/XLA path-context encoder with masked attention pooling, full and sampled
+softmax over the method-name vocabulary, data/model-parallel training over a
+`jax.sharding.Mesh`, orbax checkpointing, subtoken-F1 evaluation, interactive
+prediction, and word2vec-format embedding export.
+
+The design is TPU-first, not a port: static shapes throughout, batched MXU
+matmuls, XLA SPMD collectives over ICI for scaling (no NCCL analog), and
+Pallas kernels for the fused attention-pool hot path.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,245 @@
+"""Configuration for code2vec-tpu.
+
+Mirrors the reference's flat `Config` namespace and CLI flag names
+(SURVEY.md §3 "Config/flags": `config.py` in the reference exposes every
+hyperparameter as an UPPERCASE class attribute plus an argparse overlay and
+derived path properties) so `train.sh`-style invocations run unchanged.
+
+TPU-specific knobs (mesh shape, sampled softmax, binary shards, bf16) are
+additive — absent flags keep reference defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import os
+import sys
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- capacities (reference defaults, SURVEY.md §3 config row) ----
+    MAX_CONTEXTS: int = 200
+    MAX_TOKEN_VOCAB_SIZE: int = 1301136
+    MAX_TARGET_VOCAB_SIZE: int = 261245
+    MAX_PATH_VOCAB_SIZE: int = 911417
+
+    # ---- model dims ----
+    DEFAULT_EMBEDDINGS_SIZE: int = 128
+    # Reference: TARGET_EMBEDDINGS_SIZE == code_vector_size == 3 * 128.
+    TARGET_EMBEDDINGS_SIZE: Optional[int] = None  # derived: code_vector_size
+
+    # ---- training hyperparameters ----
+    DROPOUT_KEEP_RATE: float = 0.75
+    TRAIN_BATCH_SIZE: int = 1024
+    TEST_BATCH_SIZE: int = 1024
+    NUM_TRAIN_EPOCHS: int = 20
+    SAVE_EVERY_EPOCHS: int = 1
+    MAX_TO_KEEP: int = 10
+    NUM_BATCHES_TO_LOG_PROGRESS: int = 100
+    TOP_K_WORDS_CONSIDERED_DURING_PREDICTION: int = 10
+    LEARNING_RATE: float = 0.01  # Adam; reference uses TF Adam defaults-ish
+    SEED: int = 239
+
+    # ---- softmax strategy (TPU addition; SURVEY.md §3.3 requires sampled
+    # softmax for the java-large 261K-target config) ----
+    USE_SAMPLED_SOFTMAX: bool = False
+    NUM_SAMPLED_CLASSES: int = 4096
+
+    # ---- TPU / parallelism (additive) ----
+    BACKEND: str = "tpu"  # 'tpu' | 'cpu' — selects jax platform expectations
+    MESH_DATA_AXIS: int = 0   # 0 → use all devices on the data axis
+    MESH_MODEL_AXIS: int = 1  # model-parallel degree for sharded vocab tables
+    USE_BF16: bool = True     # compute in bfloat16 on the MXU, params f32
+
+    # ---- CLI surface (reference flag names, SURVEY.md §2 L6) ----
+    train_data_path: Optional[str] = None   # --data <prefix>
+    test_data_path: Optional[str] = None    # --test <file>
+    save_path: Optional[str] = None         # --save <ckpt>
+    load_path: Optional[str] = None         # --load <ckpt>
+    is_predict: bool = False                # --predict
+    release: bool = False                   # --release
+    export_code_vectors: bool = False       # --export_code_vectors
+    save_w2v: Optional[str] = None          # --save_w2v <path>
+    save_t2v: Optional[str] = None          # --save_t2v <path>
+    DL_FRAMEWORK: str = "jax"               # --framework (reference: tensorflow|keras)
+    VERBOSE_MODE: int = 1
+
+    # ---- logging ----
+    LOG_PATH: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.TARGET_EMBEDDINGS_SIZE is None:
+            self.TARGET_EMBEDDINGS_SIZE = self.code_vector_size
+        self._logger: Optional[logging.Logger] = None
+
+    # ---- derived properties (reference parity) ----
+    @property
+    def context_vector_size(self) -> int:
+        # token + path + token embeddings concatenated
+        return 3 * self.DEFAULT_EMBEDDINGS_SIZE
+
+    @property
+    def code_vector_size(self) -> int:
+        return self.context_vector_size
+
+    @property
+    def is_training(self) -> bool:
+        return bool(self.train_data_path)
+
+    @property
+    def is_testing(self) -> bool:
+        return bool(self.test_data_path)
+
+    @property
+    def is_loading(self) -> bool:
+        return bool(self.load_path)
+
+    @property
+    def is_saving(self) -> bool:
+        return bool(self.save_path)
+
+    @property
+    def train_data_path_prefix(self) -> Optional[str]:
+        return self.train_data_path
+
+    def data_path(self, split: str) -> str:
+        """Path of one split's `.c2v` file: `<prefix>.<split>.c2v`."""
+        assert self.train_data_path is not None
+        return f"{self.train_data_path}.{split}.c2v"
+
+    @property
+    def word_freq_dict_path(self) -> Optional[str]:
+        """The `.dict.c2v` pickle written by preprocessing (SURVEY.md §3.2)."""
+        if not self.train_data_path:
+            return None
+        return f"{self.train_data_path}.dict.c2v"
+
+    @property
+    def model_load_dir(self) -> Optional[str]:
+        return self.load_path
+
+    @property
+    def entire_model_load_path(self) -> Optional[str]:
+        return self.load_path
+
+    @property
+    def entire_model_save_path(self) -> Optional[str]:
+        return self.save_path
+
+    # ---- argparse ingestion (reference flag spelling) ----
+    @classmethod
+    def arguments_parser(cls) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(description="code2vec-tpu")
+        p.add_argument("--data", dest="data_path", default=None,
+                       help="path prefix of {train,val,test}.c2v data")
+        p.add_argument("--test", dest="test_path", default=None,
+                       help="path to a .c2v test file")
+        p.add_argument("--save", dest="save_path", default=None)
+        p.add_argument("--load", dest="load_path", default=None)
+        p.add_argument("--predict", action="store_true")
+        p.add_argument("--release", action="store_true")
+        p.add_argument("--export_code_vectors", action="store_true")
+        p.add_argument("--save_w2v", dest="save_w2v", default=None)
+        p.add_argument("--save_t2v", dest="save_t2v", default=None)
+        p.add_argument("--framework", dest="dl_framework", default="jax",
+                       choices=["jax", "tensorflow", "keras"],
+                       help="accepted for CLI compatibility; always runs the "
+                            "JAX/TPU implementation")
+        p.add_argument("--backend", dest="backend", default=None,
+                       choices=["tpu", "cpu", "gpu"])
+        p.add_argument("--max_contexts", dest="max_contexts", type=int, default=None)
+        p.add_argument("--batch_size", dest="batch_size", type=int, default=None)
+        p.add_argument("--epochs", dest="epochs", type=int, default=None)
+        p.add_argument("--lr", dest="lr", type=float, default=None)
+        p.add_argument("--sampled_softmax", dest="sampled_softmax",
+                       action="store_true")
+        p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
+        p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
+        p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
+        p.add_argument("--seed", dest="seed", type=int, default=None)
+        p.add_argument("--logs-path", dest="logs_path", default=None)
+        p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
+        return p
+
+    @classmethod
+    def load_from_args(cls, args: Optional[list] = None) -> "Config":
+        ns = cls.arguments_parser().parse_args(
+            args if args is not None else sys.argv[1:])
+        cfg = cls()
+        cfg.train_data_path = ns.data_path
+        cfg.test_data_path = ns.test_path
+        cfg.save_path = ns.save_path
+        cfg.load_path = ns.load_path
+        cfg.is_predict = ns.predict
+        cfg.release = ns.release
+        cfg.export_code_vectors = ns.export_code_vectors
+        cfg.save_w2v = ns.save_w2v
+        cfg.save_t2v = ns.save_t2v
+        cfg.DL_FRAMEWORK = ns.dl_framework
+        if ns.backend is not None:
+            cfg.BACKEND = ns.backend
+        if ns.max_contexts is not None:
+            cfg.MAX_CONTEXTS = ns.max_contexts
+        if ns.batch_size is not None:
+            cfg.TRAIN_BATCH_SIZE = ns.batch_size
+        if ns.epochs is not None:
+            cfg.NUM_TRAIN_EPOCHS = ns.epochs
+        if ns.lr is not None:
+            cfg.LEARNING_RATE = ns.lr
+        if ns.sampled_softmax:
+            cfg.USE_SAMPLED_SOFTMAX = True
+        if ns.num_sampled is not None:
+            cfg.NUM_SAMPLED_CLASSES = ns.num_sampled
+        if ns.mesh_data is not None:
+            cfg.MESH_DATA_AXIS = ns.mesh_data
+        if ns.mesh_model is not None:
+            cfg.MESH_MODEL_AXIS = ns.mesh_model
+        if ns.seed is not None:
+            cfg.SEED = ns.seed
+        if ns.logs_path is not None:
+            cfg.LOG_PATH = ns.logs_path
+        if ns.verbose_mode is not None:
+            cfg.VERBOSE_MODE = ns.verbose_mode
+        cfg.verify()
+        return cfg
+
+    def verify(self) -> None:
+        """Validate flag combinations (reference `Config.verify`)."""
+        if not (self.is_training or self.is_loading):
+            raise ValueError(
+                "Must train (--data) or load a trained model (--load).")
+        if self.is_predict and not self.is_loading:
+            raise ValueError("--predict requires --load.")
+        if self.release and not self.is_loading:
+            raise ValueError("--release requires --load.")
+        if self.MAX_CONTEXTS <= 0:
+            raise ValueError("MAX_CONTEXTS must be positive.")
+        if self.USE_SAMPLED_SOFTMAX and self.NUM_SAMPLED_CLASSES <= 0:
+            raise ValueError("NUM_SAMPLED_CLASSES must be positive.")
+
+    def get_logger(self) -> logging.Logger:
+        if self._logger is None:
+            logger = logging.getLogger("code2vec-tpu")
+            logger.setLevel(logging.INFO if self.VERBOSE_MODE >= 1
+                            else logging.WARNING)
+            if not logger.handlers:
+                sh = logging.StreamHandler(sys.stdout)
+                sh.setFormatter(logging.Formatter(
+                    "%(asctime)s %(levelname)s %(message)s"))
+                logger.addHandler(sh)
+                if self.LOG_PATH:
+                    os.makedirs(os.path.dirname(self.LOG_PATH) or ".",
+                                exist_ok=True)
+                    fh = logging.FileHandler(self.LOG_PATH)
+                    fh.setFormatter(logging.Formatter(
+                        "%(asctime)s %(levelname)s %(message)s"))
+                    logger.addHandler(fh)
+            self._logger = logger
+        return self._logger
+
+    def log(self, msg: str) -> None:
+        self.get_logger().info(msg)

@@ -1,0 +1,2 @@
+from code2vec_tpu.data.reader import (  # noqa: F401
+    BatchTensors, C2VTextReader, BinaryShardReader, open_reader)

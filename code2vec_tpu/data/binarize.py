@@ -1,0 +1,93 @@
+"""Offline int-izer: `.c2v` text -> pre-tokenized int32 binary shard.
+
+SURVEY.md §8.3 step 2: host CSV parsing is the #1 throughput risk for the
+8x target, so training reads memmapped int32 shards instead of text. The
+shard is a [N, 1 + 3*C] int32 matrix per example row:
+  col 0                     : target label index
+  cols 1        .. C        : source-token indices
+  cols 1 +   C  .. 2C       : path indices
+  cols 1 + 2*C  .. 3C       : target-token indices
+padded positions hold the PAD index; the padding mask is recomputed at read
+time as `path != PAD` (a real context always has a path).
+
+Usage:
+  python -m code2vec_tpu.data.binarize --data prefix  # binarizes
+      prefix.{train,val,test}.c2v using prefix.dict.c2v vocabularies
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from code2vec_tpu.data.reader import parse_c2v_rows
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+
+def binarize_file(c2v_path: str, out_prefix: str, vocabs: Code2VecVocabs,
+                  max_contexts: int, chunk: int = 8192) -> int:
+    """Stream-convert one `.c2v` file; returns example count."""
+    C = max_contexts
+    row_width = 1 + 3 * C
+    n_total = 0
+    tmp_path = out_prefix + ".bin.tmp"
+    with open(c2v_path, "r", encoding="utf-8", errors="replace") as fin, \
+            open(tmp_path, "wb") as fout:
+        batch = []
+        for line in fin:
+            if not line.strip():
+                continue
+            batch.append(line)
+            if len(batch) >= chunk:
+                n_total += _write_chunk(batch, fout, vocabs, C, row_width)
+                batch = []
+        if batch:
+            n_total += _write_chunk(batch, fout, vocabs, C, row_width)
+    os.replace(tmp_path, out_prefix + ".bin")
+    with open(out_prefix + ".bin.json", "w") as f:
+        json.dump({"num_examples": n_total, "max_contexts": C,
+                   "pad_index": vocabs.token_vocab.pad_index,
+                   "layout": "label,src*C,path*C,tgt*C", "dtype": "int32"},
+                  f)
+    return n_total
+
+
+def _write_chunk(lines, fout, vocabs, C, row_width) -> int:
+    labels, src, pth, dst, _mask, _, _ = parse_c2v_rows(lines, vocabs, C)
+    rows = np.empty((len(lines), row_width), dtype=np.int32)
+    rows[:, 0] = labels
+    rows[:, 1:1 + C] = src
+    rows[:, 1 + C:1 + 2 * C] = pth
+    rows[:, 1 + 2 * C:1 + 3 * C] = dst
+    rows.tofile(fout)
+    return len(lines)
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(description="code2vec-tpu binarize")
+    p.add_argument("--data", required=True,
+                   help="dataset prefix (expects <prefix>.{split}.c2v and "
+                        "<prefix>.dict.c2v)")
+    p.add_argument("--max_contexts", type=int, default=200)
+    p.add_argument("--word_vocab_size", type=int, default=1301136)
+    p.add_argument("--path_vocab_size", type=int, default=911417)
+    p.add_argument("--target_vocab_size", type=int, default=261245)
+    args = p.parse_args(argv)
+
+    vocabs = Code2VecVocabs.load_from_dict_file(
+        args.data + ".dict.c2v", args.word_vocab_size,
+        args.path_vocab_size, args.target_vocab_size)
+    for split in ("train", "val", "test"):
+        c2v = f"{args.data}.{split}.c2v"
+        if os.path.exists(c2v):
+            n = binarize_file(c2v, f"{args.data}.{split}", vocabs,
+                              args.max_contexts)
+            print(f"binarize: {c2v} -> {n} examples")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Host-side input pipeline: `.c2v` text / binary shards -> fixed-shape
+int32 batches + padding mask.
+
+Reference parity target: `path_context_reader.py` (SURVEY.md §2 L3, §3):
+`PathContextReader` yielding `ReaderInputTensors` (target idx, three
+[B, MAX_CONTEXTS] context index tensors, `context_valid_mask`, plus string
+fields for eval/predict). TPU-first differences:
+
+- No tf.data graph; the host produces numpy arrays with STATIC shapes
+  (the final short batch is padded and carries `num_valid`) so the jitted
+  step never re-traces.
+- The fast path is pre-binarized int32 shards (data/binarize.py) read via
+  np.memmap — CSV/string parsing on the host is the #1 throughput risk for
+  the 8x target (SURVEY.md §8.3 step 2).
+- Shuffle is an index permutation per epoch, seeded for reproducibility.
+- `host_shard` / `num_host_shards` slice the example space for multi-host
+  feeding (each host feeds its local devices; SURVEY.md §3.3 "Infeed").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, NamedTuple, Optional
+
+import numpy as np
+
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+
+class BatchTensors(NamedTuple):
+    """One host batch. Shapes are static: [B] / [B, C]."""
+    target_index: np.ndarray            # int32 [B]
+    path_source_token_indices: np.ndarray  # int32 [B, C]
+    path_indices: np.ndarray            # int32 [B, C]
+    path_target_token_indices: np.ndarray  # int32 [B, C]
+    context_valid_mask: np.ndarray      # float32 [B, C]; 1.0 = real context
+    num_valid_examples: int             # <= B; B unless final padded batch
+    target_strings: Optional[List[str]] = None   # eval/predict only
+    context_strings: Optional[List[List[str]]] = None  # predict only
+
+
+def parse_c2v_rows(lines: List[str], vocabs: Code2VecVocabs,
+                   max_contexts: int, keep_strings: bool = False):
+    """Vectorized-enough parse of `.c2v` rows into index arrays.
+
+    A context field is `left,path,right`; empty ('' or ',,') fields are
+    padding (PAD index, mask 0). OOV words map to the OOV index
+    (SURVEY.md §3.2).
+    """
+    n = len(lines)
+    tok_v, path_v, tgt_v = (vocabs.token_vocab, vocabs.path_vocab,
+                            vocabs.target_vocab)
+    labels = np.zeros((n,), dtype=np.int32)
+    src = np.full((n, max_contexts), tok_v.pad_index, dtype=np.int32)
+    pth = np.full((n, max_contexts), path_v.pad_index, dtype=np.int32)
+    dst = np.full((n, max_contexts), tok_v.pad_index, dtype=np.int32)
+    mask = np.zeros((n, max_contexts), dtype=np.float32)
+    target_strings: List[str] = []
+    context_strings: List[List[str]] = []
+    for i, line in enumerate(lines):
+        parts = line.rstrip("\n").split(" ")
+        target = parts[0]
+        labels[i] = tgt_v.lookup_index(target)
+        if keep_strings:
+            target_strings.append(target)
+            context_strings.append(parts[1:1 + max_contexts])
+        for j, ctx in enumerate(parts[1:1 + max_contexts]):
+            if not ctx or ctx == ",,":
+                continue
+            fields = ctx.split(",")
+            if len(fields) != 3 or not fields[1]:
+                continue
+            src[i, j] = tok_v.lookup_index(fields[0])
+            pth[i, j] = path_v.lookup_index(fields[1])
+            dst[i, j] = tok_v.lookup_index(fields[2])
+            mask[i, j] = 1.0
+    return labels, src, pth, dst, mask, target_strings, context_strings
+
+
+def _pad_batch(arrs, batch_size: int):
+    """Pad along axis 0 to `batch_size` by repeating zeros/PAD rows."""
+    out = []
+    for a in arrs:
+        pad = batch_size - a.shape[0]
+        if pad > 0:
+            a = np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0)
+        out.append(a)
+    return out
+
+
+class C2VTextReader:
+    """Slow-path reader over a `.c2v` text file (drop-in compatibility
+    with reference-produced data)."""
+
+    def __init__(self, path: str, vocabs: Code2VecVocabs, max_contexts: int,
+                 batch_size: int, shuffle: bool = False, seed: int = 0,
+                 keep_strings: bool = False,
+                 host_shard: int = 0, num_host_shards: int = 1):
+        self.path = path
+        self.vocabs = vocabs
+        self.max_contexts = max_contexts
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.keep_strings = keep_strings
+        self.host_shard = host_shard
+        self.num_host_shards = num_host_shards
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[BatchTensors]:
+        with open(self.path, "r", encoding="utf-8",
+                  errors="replace") as f:
+            lines = [ln for ln in f if ln.strip()]
+        lines = lines[self.host_shard::self.num_host_shards]
+        order = np.arange(len(lines))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+            self._epoch += 1
+        for start in range(0, len(lines), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            batch_lines = [lines[i] for i in idx]
+            labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
+                batch_lines, self.vocabs, self.max_contexts,
+                self.keep_strings)
+            nv = len(batch_lines)
+            labels, src, pth, dst, mask = _pad_batch(
+                (labels, src, pth, dst, mask), self.batch_size)
+            yield BatchTensors(labels, src, pth, dst, mask, nv,
+                               tstr if self.keep_strings else None,
+                               cstr if self.keep_strings else None)
+
+
+class BinaryShardReader:
+    """Fast-path reader over the pre-tokenized int32 shard written by
+    data/binarize.py: a memmapped [N, 1 + 3*C] int32 matrix
+    (label, src*C, path*C, tgt*C) + a JSON manifest."""
+
+    def __init__(self, prefix: str, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, host_shard: int = 0,
+                 num_host_shards: int = 1):
+        with open(prefix + ".bin.json", "r") as f:
+            self.manifest = json.load(f)
+        self.max_contexts = int(self.manifest["max_contexts"])
+        self.num_examples = int(self.manifest["num_examples"])
+        row_width = 1 + 3 * self.max_contexts
+        self.data = np.memmap(prefix + ".bin", dtype=np.int32, mode="r",
+                              shape=(self.num_examples, row_width))
+        self.pad_index = int(self.manifest["pad_index"])
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.host_shard = host_shard
+        self.num_host_shards = num_host_shards
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[BatchTensors]:
+        C = self.max_contexts
+        order = np.arange(self.host_shard, self.num_examples,
+                          self.num_host_shards)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+            self._epoch += 1
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            rows = np.asarray(self.data[np.sort(idx)])
+            labels = rows[:, 0].astype(np.int32)
+            src = rows[:, 1:1 + C]
+            pth = rows[:, 1 + C:1 + 2 * C]
+            dst = rows[:, 1 + 2 * C:1 + 3 * C]
+            mask = (pth != self.pad_index).astype(np.float32)
+            nv = rows.shape[0]
+            labels, src, pth, dst, mask = _pad_batch(
+                (labels, src, pth, dst, mask), self.batch_size)
+            yield BatchTensors(labels, np.ascontiguousarray(src),
+                               np.ascontiguousarray(pth),
+                               np.ascontiguousarray(dst), mask, nv)
+
+
+def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
+                max_contexts: int, batch_size: int, shuffle: bool = False,
+                seed: int = 0, keep_strings: bool = False,
+                host_shard: int = 0, num_host_shards: int = 1):
+    """Pick the binary fast path when a `.bin` sibling exists, else text.
+    `host_shard`/`num_host_shards` (typically jax.process_index/count)
+    slice the example space so each host feeds a disjoint shard."""
+    prefix = path_or_prefix
+    if prefix.endswith(".c2v"):
+        prefix = prefix[:-len(".c2v")]
+    if os.path.exists(prefix + ".bin.json") and not keep_strings:
+        return BinaryShardReader(prefix, batch_size, shuffle=shuffle,
+                                 seed=seed, host_shard=host_shard,
+                                 num_host_shards=num_host_shards)
+    return C2VTextReader(path_or_prefix, vocabs, max_contexts, batch_size,
+                         shuffle=shuffle, seed=seed,
+                         keep_strings=keep_strings, host_shard=host_shard,
+                         num_host_shards=num_host_shards)

@@ -1,0 +1,2 @@
+from code2vec_tpu.models.encoder import (  # noqa: F401
+    ModelDims, init_params, encode, full_logits)

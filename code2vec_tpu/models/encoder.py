@@ -1,0 +1,115 @@
+"""The path-context encoder: the model core as pure functions on a pytree.
+
+Reference parity target: `tensorflow_model.py` forward graph (SURVEY.md §3):
+trainable variables WORDS_VOCAB [Vt, 128], PATHS_VOCAB [Vp, 128],
+TARGET_WORDS_VOCAB [Vy, 384], TRANSFORM [384, 384], ATTENTION [384, 1];
+forward = 3 embedding gathers -> concat(384) -> dropout(keep 0.75) ->
+tanh(ctx @ TRANSFORM) -> masked attention softmax over MAX_CONTEXTS ->
+weighted sum = code vector -> logits vs TARGET_WORDS_VOCABᵀ.
+
+TPU-first design choices:
+- pure-jax param pytree (a flat dict) rather than a framework Module: the
+  five arrays are exactly the reference's variables, and explicit pytrees
+  make NamedSharding rules trivial (parallel/sharding.py).
+- vocab-table row counts are padded up to a multiple of the model-parallel
+  mesh axis so tables shard evenly (padding rows are dead: PAD/OOV indices
+  are < the true size and the sampler clips to the true vocab size).
+- compute dtype is bfloat16 on the MXU (params stay f32; casts at use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.ops.attention import attention_pool
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDims:
+    """Static model dimensions (hashable: usable as a jit static arg)."""
+    token_vocab_size: int
+    path_vocab_size: int
+    target_vocab_size: int
+    embeddings_size: int = 128
+    max_contexts: int = 200
+    dropout_keep_rate: float = 0.75
+    # Row padding so vocab dims divide the 'model' mesh axis evenly.
+    vocab_pad_multiple: int = 1
+
+    @property
+    def context_vector_size(self) -> int:
+        return 3 * self.embeddings_size
+
+    @property
+    def code_vector_size(self) -> int:
+        return self.context_vector_size
+
+    def padded(self, n: int) -> int:
+        m = self.vocab_pad_multiple
+        return ((n + m - 1) // m) * m
+
+
+def init_params(rng: jax.Array, dims: ModelDims,
+                dtype=jnp.float32) -> Params:
+    """Variance-scaled init, matching the reference's scheme in spirit
+    (TF used glorot-ish initializers on the tables and TRANSFORM)."""
+    k_tok, k_path, k_tgt, k_tr, k_at = jax.random.split(rng, 5)
+    E = dims.embeddings_size
+    D = dims.context_vector_size
+    init = jax.nn.initializers.variance_scaling(
+        1.0, "fan_avg", "uniform")
+    return {
+        "token_emb": init(k_tok, (dims.padded(dims.token_vocab_size), E),
+                          dtype),
+        "path_emb": init(k_path, (dims.padded(dims.path_vocab_size), E),
+                         dtype),
+        "target_emb": init(k_tgt, (dims.padded(dims.target_vocab_size), D),
+                           dtype),
+        "transform": init(k_tr, (D, D), dtype),
+        "attention": init(k_at, (D, 1), dtype)[:, 0],
+    }
+
+
+def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
+           target_ids: jax.Array, mask: jax.Array, *,
+           dropout_rng: Optional[jax.Array] = None,
+           dropout_keep_rate: float = 1.0,
+           compute_dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """Forward to the code vector.
+
+    Args: [B, C] int32 ids for source token / path / target token, [B, C]
+    f32 mask. Returns (code_vectors [B, D] in compute dtype,
+    attention [B, C] f32).
+    """
+    src = jnp.take(params["token_emb"], source_ids, axis=0)
+    pth = jnp.take(params["path_emb"], path_ids, axis=0)
+    dst = jnp.take(params["token_emb"], target_ids, axis=0)
+    contexts = jnp.concatenate([src, pth, dst], axis=-1).astype(compute_dtype)
+
+    if dropout_rng is not None and dropout_keep_rate < 1.0:
+        keep = jax.random.bernoulli(dropout_rng, dropout_keep_rate,
+                                    contexts.shape)
+        contexts = jnp.where(keep, contexts / dropout_keep_rate, 0.0)
+
+    return attention_pool(contexts, params["transform"],
+                          params["attention"], mask)
+
+
+def full_logits(params: Params, code_vectors: jax.Array,
+                true_target_vocab_size: Optional[int] = None) -> jax.Array:
+    """[B, V] logits against the (possibly row-padded) target table.
+    Padding rows are masked to -inf so they never win top-k."""
+    table = params["target_emb"].astype(code_vectors.dtype)
+    logits = (code_vectors @ table.T).astype(jnp.float32)
+    if (true_target_vocab_size is not None
+            and true_target_vocab_size < table.shape[0]):
+        col = jnp.arange(table.shape[0])
+        logits = jnp.where(col[None, :] < true_target_vocab_size,
+                           logits, -1e9)
+    return logits

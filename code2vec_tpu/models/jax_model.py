@@ -1,0 +1,289 @@
+"""The concrete JAX/TPU code2vec model.
+
+Reference parity target: `tensorflow_model.Code2VecModel`
+(SURVEY.md §3, §4.2–§4.5) — training loop with throughput logging,
+evaluation with top-k + subtoken metrics, raw-line prediction with
+attention output, checkpoint save/load/release, embedding export. The
+compute path is the jitted steps in training/steps.py; this class is host
+orchestration only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from code2vec_tpu.common import (EvaluationResults, MethodPredictionResults,
+                                 SpecialVocabWords)
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import (BatchTensors, _pad_batch, open_reader,
+                                      parse_c2v_rows)
+from code2vec_tpu.models.encoder import ModelDims, init_params
+from code2vec_tpu.models.model_base import Code2VecModelBase, MetricAccumulator
+from code2vec_tpu.parallel.mesh import make_mesh
+from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
+                                            shard_params)
+from code2vec_tpu.training import checkpoint as ckpt
+from code2vec_tpu.training.steps import (make_encode_step, make_eval_step,
+                                         make_predict_step, make_train_step)
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, VocabType
+
+
+class Code2VecModel(Code2VecModelBase):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        cfg = config
+        self.log = cfg.log
+        self.compute_dtype = jnp.bfloat16 if cfg.USE_BF16 else jnp.float32
+
+        # ---- mesh (SURVEY.md §3.3): data axis for DP, model axis for
+        # sharded vocab tables; single-device runs use no mesh. ----
+        n_dev = len(jax.devices())
+        self.mesh = None
+        model_axis = max(1, cfg.MESH_MODEL_AXIS)
+        if n_dev > 1 or model_axis > 1:
+            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis)
+
+        if cfg.is_loading:
+            # Dims come from the checkpoint manifest, not the CLI: a model
+            # trained with different max_contexts / pad multiple must
+            # restore bit-exactly regardless of current flags.
+            self.dims = ckpt.load_dims(cfg.load_path)
+            cfg.MAX_CONTEXTS = self.dims.max_contexts
+            manifest = ckpt.load_manifest(cfg.load_path)
+            cfg.USE_SAMPLED_SOFTMAX = manifest.get(
+                "use_sampled_softmax", cfg.USE_SAMPLED_SOFTMAX)
+            cfg.NUM_SAMPLED_CLASSES = manifest.get(
+                "num_sampled", cfg.NUM_SAMPLED_CLASSES)
+        else:
+            self.dims = ModelDims(
+                token_vocab_size=self.vocabs.token_vocab.size,
+                path_vocab_size=self.vocabs.path_vocab.size,
+                target_vocab_size=self.vocabs.target_vocab.size,
+                embeddings_size=cfg.DEFAULT_EMBEDDINGS_SIZE,
+                max_contexts=cfg.MAX_CONTEXTS,
+                dropout_keep_rate=cfg.DROPOUT_KEEP_RATE,
+                vocab_pad_multiple=model_axis,
+            )
+        self.optimizer = optax.adam(cfg.LEARNING_RATE)
+        self.rng = jax.random.PRNGKey(cfg.SEED)
+
+        # ---- params: load (--load) or init ----
+        self.step_num = 0
+        self.rng, init_rng = jax.random.split(self.rng)
+        params = init_params(init_rng, self.dims)
+        opt_state = self.optimizer.init(params)
+        if cfg.is_loading:
+            if manifest.get("released"):
+                loaded = ckpt.load_checkpoint(cfg.load_path,
+                                              {"params": params})
+                params = loaded["params"]
+                opt_state = self.optimizer.init(params)
+                self.step_num = int(manifest.get("step", 0))
+            else:
+                full = ckpt.load_checkpoint(
+                    cfg.load_path, {"params": params,
+                                    "opt_state": opt_state,
+                                    "step": 0})
+                params, opt_state = full["params"], full["opt_state"]
+                self.step_num = int(full.get("step", 0))
+        if self.mesh is not None:
+            params = shard_params(self.mesh, params)
+            opt_state = shard_opt_state(self.mesh, opt_state, params)
+        self.params, self.opt_state = params, opt_state
+
+        # ---- jitted steps ----
+        self._train_step = make_train_step(
+            self.dims, self.optimizer,
+            use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
+            num_sampled=cfg.NUM_SAMPLED_CLASSES,
+            compute_dtype=self.compute_dtype)
+        top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
+        self._eval_step = make_eval_step(self.dims, top_k=top_k,
+                                         compute_dtype=self.compute_dtype)
+        self._predict_step = make_predict_step(
+            self.dims, top_k=top_k, compute_dtype=self.compute_dtype)
+
+    # ---- vocabs: dataset dict when training, checkpoint sidecar when
+    # loading (SURVEY.md §3.2 "Model checkpoint") ----
+    def _load_or_create_vocabs(self) -> Code2VecVocabs:
+        cfg = self.config
+        if cfg.is_loading:
+            return ckpt.load_vocabs(cfg.load_path)
+        assert cfg.word_freq_dict_path is not None, (
+            "need --data (for its .dict.c2v) or --load")
+        return Code2VecVocabs.load_from_dict_file(
+            cfg.word_freq_dict_path, cfg.MAX_TOKEN_VOCAB_SIZE,
+            cfg.MAX_PATH_VOCAB_SIZE, cfg.MAX_TARGET_VOCAB_SIZE)
+
+    # ---- helpers ----
+    def _device_batch(self, b: BatchTensors):
+        weights = np.zeros((b.target_index.shape[0],), dtype=np.float32)
+        weights[:b.num_valid_examples] = 1.0
+        arrays = (b.target_index, b.path_source_token_indices,
+                  b.path_indices, b.path_target_token_indices,
+                  b.context_valid_mask, weights)
+        if self.mesh is not None:
+            return shard_batch(self.mesh, arrays)
+        return arrays
+
+    def _ids_to_words(self, topk_ids: np.ndarray) -> List[List[str]]:
+        tv = self.vocabs.target_vocab
+        return [[tv.lookup_word(int(i)) for i in row] for row in topk_ids]
+
+    # ---- train (SURVEY.md §4.2) ----
+    def train(self) -> None:
+        cfg = self.config
+        reader = open_reader(
+            cfg.data_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
+            cfg.TRAIN_BATCH_SIZE, shuffle=True, seed=cfg.SEED,
+            host_shard=jax.process_index(),
+            num_host_shards=jax.process_count())
+        self.log(f"starting training: dims={self.dims}, "
+                 f"devices={len(jax.devices())}, mesh={self.mesh}")
+        window_examples = 0
+        window_start = time.time()
+        for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
+            for batch in reader:
+                dev_batch = self._device_batch(batch)
+                self.rng, step_rng = jax.random.split(self.rng)
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.opt_state, dev_batch, step_rng)
+                self.step_num += 1
+                window_examples += batch.num_valid_examples
+                if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
+                    loss_f = float(loss)  # device sync only on log steps
+                    dt = time.time() - window_start
+                    ex_s = window_examples / max(dt, 1e-9)
+                    # path-contexts/sec = examples/sec * MAX_CONTEXTS —
+                    # the BASELINE.json metric (SURVEY.md §4.2).
+                    self.log(
+                        f"epoch {epoch} step {self.step_num}: "
+                        f"loss {loss_f:.4f}, {ex_s:.1f} ex/s, "
+                        f"{ex_s * cfg.MAX_CONTEXTS:.0f} path-contexts/s")
+                    window_examples, window_start = 0, time.time()
+            if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                self.save(cfg.save_path)
+                if cfg.is_testing:
+                    results = self.evaluate()
+                    self.log(f"epoch {epoch} evaluation: {results}")
+        self.log("training done")
+
+    # ---- evaluate (SURVEY.md §4.3) ----
+    def evaluate(self) -> EvaluationResults:
+        cfg = self.config
+        assert cfg.test_data_path, "evaluate requires --test"
+        reader = open_reader(
+            cfg.test_data_path, self.vocabs, cfg.MAX_CONTEXTS,
+            cfg.TEST_BATCH_SIZE, shuffle=False, keep_strings=True)
+        acc = MetricAccumulator(
+            cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)
+        for batch in reader:
+            dev_batch = self._device_batch(batch)
+            loss_sum, topk_ids, _ = self._eval_step(self.params, dev_batch)
+            nv = batch.num_valid_examples
+            names = (batch.target_strings[:nv] if batch.target_strings
+                     else [self.vocabs.target_vocab.lookup_word(int(i))
+                           for i in batch.target_index[:nv]])
+            words = self._ids_to_words(np.asarray(topk_ids)[:nv])
+            acc.update_batch(names, words, float(loss_sum))
+        return acc.results()
+
+    # ---- predict raw extractor lines (SURVEY.md §4.4) ----
+    def predict(self, predict_data_lines: Iterable[str]
+                ) -> List[MethodPredictionResults]:
+        cfg = self.config
+        lines = [ln for ln in predict_data_lines if ln.strip()]
+        if not lines:
+            return []
+        labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
+            lines, self.vocabs, cfg.MAX_CONTEXTS, keep_strings=True)
+        n = len(lines)
+        # Pad the leading dim to the next power of two: the jitted predict
+        # step compiles O(log n) variants instead of one per method count.
+        padded_n = max(1, 1 << (n - 1).bit_length())
+        weights = np.zeros((padded_n,), dtype=np.float32)
+        weights[:n] = 1.0
+        labels, src, pth, dst, mask = _pad_batch(
+            (labels, src, pth, dst, mask), padded_n)
+        batch = (labels, src, pth, dst, mask, weights)
+        topk_ids, topk_probs, attn, code = self._predict_step(
+            self.params, batch)
+        topk_ids = np.asarray(topk_ids)
+        topk_probs = np.asarray(topk_probs)
+        attn = np.asarray(attn)
+        code = np.asarray(code)
+        results = []
+        for i, original in enumerate(tstr):
+            res = MethodPredictionResults(original_name=original)
+            for j in range(topk_ids.shape[1]):
+                word = self.vocabs.target_vocab.lookup_word(
+                    int(topk_ids[i, j]))
+                if word == SpecialVocabWords.PAD:
+                    continue
+                res.append_prediction(word, float(topk_probs[i, j]))
+            # attention-ranked path-contexts for interpretability
+            ctx_fields = cstr[i]
+            order = np.argsort(-attn[i])
+            for j in order:
+                if j >= len(ctx_fields) or mask[i, j] == 0:
+                    continue
+                parts = ctx_fields[j].split(",")
+                if len(parts) != 3:
+                    continue
+                res.append_attention_path(float(attn[i, j]), parts[0],
+                                          parts[1], parts[2])
+            if cfg.export_code_vectors:
+                res.code_vector = code[i]
+            results.append(res)
+        return results
+
+    # ---- persistence ----
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.config.save_path
+        assert path
+        state = {"params": self.params, "opt_state": self.opt_state,
+                 "step": self.step_num}
+        extra = {"use_sampled_softmax": self.config.USE_SAMPLED_SOFTMAX,
+                 "num_sampled": self.config.NUM_SAMPLED_CLASSES}
+        ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
+                             self.dims, extra_manifest=extra,
+                             max_to_keep=self.config.MAX_TO_KEEP)
+        self.log(f"saved checkpoint step {self.step_num} -> {path}")
+
+    def release(self) -> None:
+        cfg = self.config
+        assert cfg.load_path
+        dest = cfg.save_path or (cfg.load_path.rstrip("/") + ".release")
+        ckpt.release_checkpoint(cfg.load_path, dest, self.params)
+        self.log(f"released inference checkpoint -> {dest}")
+
+    def get_embedding_table(self, vocab_type: VocabType) -> np.ndarray:
+        key = {VocabType.Token: "token_emb", VocabType.Path: "path_emb",
+               VocabType.Target: "target_emb"}[vocab_type]
+        table = np.asarray(jax.device_get(self.params[key]),
+                           dtype=np.float32)
+        return table[:self.vocabs.get(vocab_type).size]
+
+    def export_code_vectors_file(self, test_path: str,
+                                 dest_path: str) -> None:
+        """--export_code_vectors during --test: one code vector per test
+        example, in input order (reference writes `<test>.vectors`)."""
+        cfg = self.config
+        reader = open_reader(test_path, self.vocabs, cfg.MAX_CONTEXTS,
+                             cfg.TEST_BATCH_SIZE, shuffle=False,
+                             keep_strings=True)
+        encode_step = make_encode_step(self.dims,
+                                       compute_dtype=self.compute_dtype)
+        with open(dest_path, "w", encoding="utf-8") as f:
+            for batch in reader:
+                dev_batch = self._device_batch(batch)
+                code = encode_step(self.params, dev_batch)
+                code = np.asarray(code)[:batch.num_valid_examples]
+                for row in code:
+                    f.write(" ".join(f"{x:.6f}" for x in row) + "\n")

@@ -1,0 +1,3 @@
+from code2vec_tpu.ops.attention import attention_pool  # noqa: F401
+from code2vec_tpu.ops.sampled_softmax import (  # noqa: F401
+    sampled_softmax_loss, log_uniform_sample)

@@ -1,0 +1,47 @@
+"""Masked attention pooling over a bag of context vectors.
+
+The reference computes (SURVEY.md §3 `tensorflow_model.py` row,
+`_calculate_weighted_contexts`): transformed contexts
+`ctx~ = tanh(ctx @ TRANSFORM)`, attention logits `ctx~ @ ATTENTION` with
+`log(valid_mask)` added (padding positions get -inf), softmax over the
+MAX_CONTEXTS axis, and the attention-weighted sum of `ctx~` as the code
+vector.
+
+TPU notes: the whole block is a pair of MXU matmuls ([B*C, D] @ [D, D] and
+the [B, C] x [B, C, D] weighted reduction) plus elementwise ops that XLA
+fuses; computation runs in the caller's dtype (bf16 on TPU) with the
+softmax in f32 for stability.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def attention_pool(contexts: jax.Array, transform: jax.Array,
+                   attention: jax.Array,
+                   mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Args:
+      contexts:  [B, C, D] context vectors (already concatenated + dropout).
+      transform: [D, D] the TRANSFORM matrix.
+      attention: [D] the ATTENTION vector.
+      mask:      [B, C] 1.0 for real contexts, 0.0 for padding.
+
+    Returns:
+      code_vectors: [B, D] attention-weighted sums of transformed contexts.
+      attn_weights: [B, C] f32 softmax weights (0 at padded positions).
+    """
+    transformed = jnp.tanh(contexts @ transform.astype(contexts.dtype))
+    scores = (transformed @ attention.astype(contexts.dtype)).astype(
+        jnp.float32)  # [B, C]
+    neg_inf = jnp.asarray(-1e9, dtype=jnp.float32)
+    scores = jnp.where(mask > 0, scores, neg_inf)
+    attn = jax.nn.softmax(scores, axis=-1)  # f32 [B, C]
+    # Guard the all-padding row (softmax over all -1e9 is uniform garbage):
+    any_valid = (jnp.sum(mask, axis=-1, keepdims=True) > 0)
+    attn = jnp.where(any_valid, attn, 0.0)
+    code = jnp.einsum("bc,bcd->bd", attn.astype(contexts.dtype), transformed)
+    return code, attn

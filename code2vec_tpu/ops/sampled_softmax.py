@@ -1,0 +1,94 @@
+"""Sampled softmax over a large target vocabulary.
+
+SURVEY.md §3.3 / §8.4: the java-large config (261K method-name targets)
+requires a TPU-friendly sampled softmax matching
+`tf.nn.sampled_softmax_loss` semantics — a log-uniform (Zipfian) candidate
+sampler and the log-expected-count bias correction — or subtoken-F1 will
+not match the reference.
+
+Semantics implemented (matching TF's defaults):
+- candidates ~ log-uniform over [0, V): P(k) = log((k+2)/(k+1)) / log(V+1),
+  so frequency-sorted vocabularies (ours are: Vocab.create_from_freq_dict
+  sorts by descending count) get Zipf-like negatives;
+- one shared candidate set per step (TF shares candidates across the batch);
+- bias correction subtracts log(expected_count) from each candidate's and
+  the true class's logits; TF's unique-sampler expectation is
+  E[count] = -expm1(S * log1p(-p));
+- accidental hits (a sampled negative equal to the true label) are masked
+  to -inf, as with TF's `remove_accidental_hits=True`.
+
+All shapes are static (S = num_sampled) so the step jits once. The gather
+of S + B rows from the [V, D] target table is the whole point: the dense
+[B, V] logits matmul (the full-softmax path) is replaced by [B, D] @ [D, S].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def log_uniform_sample(rng: jax.Array, num_sampled: int,
+                       vocab_size: int) -> jax.Array:
+    """Draw `num_sampled` class ids (with replacement) from the
+    log-uniform distribution over [0, vocab_size)."""
+    u = jax.random.uniform(rng, (num_sampled,), dtype=jnp.float32)
+    s = jnp.exp(u * jnp.log(float(vocab_size + 1))) - 1.0
+    return jnp.clip(s.astype(jnp.int32), 0, vocab_size - 1)
+
+
+def _log_expected_count(ids: jax.Array, num_sampled: int,
+                        vocab_size: int) -> jax.Array:
+    k = ids.astype(jnp.float32)
+    p = jnp.log1p(1.0 / (k + 1.0)) / jnp.log(float(vocab_size + 1))
+    # TF log_uniform_candidate_sampler(unique=True) expected count:
+    return jnp.log(-jnp.expm1(num_sampled * jnp.log1p(-p)))
+
+
+def sampled_softmax_loss(
+        target_table: jax.Array, code_vectors: jax.Array,
+        labels: jax.Array, rng: jax.Array, num_sampled: int,
+        example_weights: jax.Array | None = None,
+        vocab_size: int | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Args:
+      target_table:  [V_padded, D] target-name embedding table (the softmax
+                     weights; reference TARGET_WORDS_VOCAB). May carry dead
+                     padding rows for mesh divisibility.
+      code_vectors:  [B, D].
+      labels:        [B] int32 true class ids.
+      rng:           PRNG key for candidate sampling.
+      num_sampled:   S, static.
+      example_weights: optional [B] 0/1 weights (padded final batch).
+      vocab_size:    TRUE vocab size V <= V_padded; candidates are drawn
+                     from [0, V) so padding rows are never sampled.
+
+    Returns (mean_loss, sampled_ids).
+    """
+    if vocab_size is None:
+        vocab_size = target_table.shape[0]
+    sampled = log_uniform_sample(rng, num_sampled, vocab_size)  # [S]
+
+    dtype = code_vectors.dtype
+    true_w = target_table[labels].astype(dtype)          # [B, D]
+    sampled_w = target_table[sampled].astype(dtype)      # [S, D]
+
+    true_logits = jnp.sum(code_vectors * true_w, axis=-1).astype(jnp.float32)
+    sampled_logits = (code_vectors @ sampled_w.T).astype(jnp.float32)
+
+    true_logits = true_logits - _log_expected_count(
+        labels, num_sampled, vocab_size)
+    sampled_logits = sampled_logits - _log_expected_count(
+        sampled, num_sampled, vocab_size)[None, :]
+
+    accidental = sampled[None, :] == labels[:, None]     # [B, S]
+    sampled_logits = jnp.where(accidental, -1e9, sampled_logits)
+
+    logits = jnp.concatenate([true_logits[:, None], sampled_logits], axis=1)
+    per_example = -jax.nn.log_softmax(logits, axis=-1)[:, 0]
+    if example_weights is not None:
+        denom = jnp.maximum(jnp.sum(example_weights), 1.0)
+        return jnp.sum(per_example * example_weights) / denom, sampled
+    return jnp.mean(per_example), sampled

@@ -1,0 +1,3 @@
+from code2vec_tpu.parallel.mesh import make_mesh  # noqa: F401
+from code2vec_tpu.parallel.sharding import (  # noqa: F401
+    param_pspecs, batch_pspec, shard_params, shard_batch)

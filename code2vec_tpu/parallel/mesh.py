@@ -1,0 +1,48 @@
+"""Device-mesh construction.
+
+SURVEY.md §3.3: the reference is single-device; the TPU framework scales by
+SPMD over a `jax.sharding.Mesh` — the batch rides the 'data' axis
+(gradient allreduce over ICI, replacing any NCCL analog) and the large
+vocab tables shard over the 'model' axis. Axes are named, so a future
+multi-slice ('dcn', 'data', 'model') mesh is a pure relabeling
+(SURVEY.md §3.3 "keep mesh axes abstract").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: int = 0, model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('data', 'model') mesh.
+
+    data=0 means "use all remaining devices on the data axis". For
+    multi-host runs `jax.devices()` already spans hosts, so the same call
+    produces a global mesh (jax.distributed.initialize is handled by the
+    trainer entry point).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if model <= 0:
+        model = 1
+    if data <= 0:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        # Allow a mesh over a subset only when explicitly requested.
+        if data * model > n:
+            raise ValueError(
+                f"mesh {data}x{model} needs {data * model} devices, "
+                f"have {n}")
+        devs = devs[: data * model]
+    arr = np.asarray(devs).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

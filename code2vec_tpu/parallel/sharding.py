@@ -1,0 +1,73 @@
+"""Sharding rules: how the code2vec pytree and batches lay out on a mesh.
+
+SURVEY.md §3.3 (TPU-native equivalents table):
+- DP: batch dim sharded over 'data'; XLA inserts the gradient psum over
+  ICI automatically during SPMD partitioning of the jitted step.
+- TP (embedding sharding): the token table (~1.3M x 128) and target table
+  (~261K x 384) shard their VOCAB dim over 'model' so dense embedding
+  gradients scale (SURVEY.md §8.4 item 2). XLA turns `jnp.take` on a
+  row-sharded table into a dynamic-slice + partial gather + psum, and the
+  [B, D] @ [D, V] logits matmul into a reduce-scatter-friendly form.
+- TRANSFORM / ATTENTION are tiny: replicated.
+
+Vocab row counts must divide the model axis — ModelDims.vocab_pad_multiple
+handles the padding at init time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def param_pspecs() -> Dict[str, P]:
+    return {
+        "token_emb": P(MODEL_AXIS, None),
+        "path_emb": P(MODEL_AXIS, None),
+        "target_emb": P(MODEL_AXIS, None),
+        "transform": P(None, None),
+        "attention": P(None),
+    }
+
+
+def batch_pspec() -> P:
+    """Leading (batch) dim over 'data'; everything else replicated."""
+    return P(DATA_AXIS)
+
+
+def shard_params(mesh: Mesh, params) -> Dict[str, jax.Array]:
+    specs = param_pspecs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def shard_opt_state(mesh: Mesh, opt_state, params):
+    """Optimizer slots mirror their parameter's sharding; scalars/steps
+    replicate."""
+    specs = param_pspecs()
+    # optax states are pytrees whose array leaves either match a param
+    # shape (moments) or are scalars (counts). Map by shape.
+    shapes_to_spec = {}
+    for k, v in params.items():
+        shapes_to_spec.setdefault(v.shape, specs[k])
+
+    def put(leaf):
+        if hasattr(leaf, "shape") and leaf.shape in shapes_to_spec:
+            return jax.device_put(
+                leaf, NamedSharding(mesh, shapes_to_spec[leaf.shape]))
+        if hasattr(leaf, "shape"):
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        return leaf
+
+    return jax.tree_util.tree_map(put, opt_state)
+
+
+def shard_batch(mesh: Mesh, arrays):
+    """device_put a tuple/list of [B, ...] numpy arrays with the batch dim
+    over 'data'."""
+    sh = NamedSharding(mesh, batch_pspec())
+    return tuple(jax.device_put(a, sh) for a in arrays)

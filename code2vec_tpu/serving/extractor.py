@@ -1,0 +1,77 @@
+"""Single-file extraction bridge for the predict REPL.
+
+Reference parity target: `extractor.py` (SURVEY.md §2 L5, §3): subprocess
+the extractor on one file, parse stdout into (method_name, context_lines),
+raise on failure. The reference shells out to the JavaExtractor jar; we
+shell out to the native C++ extractor (code2vec_tpu/extractor/, built by
+build_extractor.sh) whose stdout format is identical (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Tuple
+
+from code2vec_tpu.config import Config
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_DEFAULT_BINARY = os.path.join(_REPO_ROOT, "code2vec_tpu", "extractor",
+                               "build", "c2v_extract")
+
+
+class ExtractorError(RuntimeError):
+    pass
+
+
+class Extractor:
+    def __init__(self, config: Config, extractor_path: str = None,
+                 max_path_length: int = 8, max_path_width: int = 2,
+                 language: str = "java"):
+        self.config = config
+        self.max_path_length = max_path_length
+        self.max_path_width = max_path_width
+        self.language = language
+        self.extractor_path = (extractor_path
+                               or os.environ.get("C2V_EXTRACTOR")
+                               or _DEFAULT_BINARY)
+
+    def _binary(self) -> str:
+        if os.path.exists(self.extractor_path):
+            return self.extractor_path
+        found = shutil.which("c2v_extract")
+        if found:
+            return found
+        raise ExtractorError(
+            f"native extractor not found at {self.extractor_path}; build "
+            f"it with ./build_extractor.sh (see code2vec_tpu/extractor/)")
+
+    def extract_paths(self, path: str) -> Tuple[List[str], List[str]]:
+        """Returns (method_names, raw_context_lines) for one source file;
+        line format: `name tok,pathHash,tok ...` (SURVEY.md §3.2)."""
+        if self.language == "python":
+            # Python parsing is native to the host (SURVEY.md §8.3 step 8)
+            try:
+                from code2vec_tpu.extractor.python_extractor import (
+                    extract_file)
+            except ImportError as e:
+                raise ExtractorError(
+                    f"python extractor unavailable: {e}") from e
+            lines = extract_file(path, self.max_path_length,
+                                 self.max_path_width)
+        else:
+            cmd = [self._binary(), "--file", path,
+                   "--max_path_length", str(self.max_path_length),
+                   "--max_path_width", str(self.max_path_width)]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+            if proc.returncode != 0:
+                raise ExtractorError(
+                    f"extractor failed ({proc.returncode}): {proc.stderr}")
+            lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            raise ExtractorError(f"no methods extracted from {path}")
+        names = [ln.split(" ", 1)[0] for ln in lines]
+        return names, lines

@@ -1,0 +1,55 @@
+"""Interactive prediction REPL.
+
+Reference parity target: `interactive_predict.py` (SURVEY.md §3, §4.4):
+"Modify Input.java, press Enter" -> extract path-contexts -> model.predict
+-> print top-k names with probabilities, attention-ranked path-contexts,
+and optionally the code vector.
+"""
+
+from __future__ import annotations
+
+import os
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.serving.extractor import Extractor, ExtractorError
+
+SHOW_TOP_CONTEXTS = 10
+DEFAULT_INPUT_FILE = "Input.java"
+EXIT_KEYWORDS = ("exit", "quit", "q")
+
+
+class InteractivePredictor:
+    def __init__(self, config: Config, model):
+        self.config = config
+        self.model = model
+        self.extractor = Extractor(config)
+
+    def predict(self, input_file: str = DEFAULT_INPUT_FILE) -> None:
+        print(f"Serving. Modify the file: \"{input_file}\", then press any "
+              f"key when ready, or \"q\" / \"quit\" / \"exit\" to exit.")
+        while True:
+            user_input = input()
+            if user_input.strip().lower() in EXIT_KEYWORDS:
+                print("Exiting...")
+                return
+            if not os.path.exists(input_file):
+                print(f"File not found: {input_file}")
+                continue
+            try:
+                _, lines = self.extractor.extract_paths(input_file)
+            except ExtractorError as e:
+                print(f"Extraction error: {e}")
+                continue
+            results = self.model.predict(lines)
+            for res in results:
+                print(f"Original name:\t{res.original_name}")
+                for pred in res.predictions:
+                    print(f"\t({pred['probability']:.6f}) "
+                          f"predicted: {pred['name']}")
+                print("Attention:")
+                for ap in res.attention_paths[:SHOW_TOP_CONTEXTS]:
+                    print(f"{ap.attention_score:.6f}\tcontext: "
+                          f"{ap.source_token},{ap.path},{ap.target_token}")
+                if res.code_vector is not None:
+                    print("Code vector:")
+                    print(" ".join(f"{x:.5f}" for x in res.code_vector))

@@ -1,0 +1,2 @@
+from code2vec_tpu.training.steps import (  # noqa: F401
+    make_train_step, make_eval_step, make_predict_step)

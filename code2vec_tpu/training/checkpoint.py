@@ -1,0 +1,132 @@
+"""Checkpoint / resume via orbax.
+
+Reference parity target (SURVEY.md §6 "Checkpoint / resume"): the reference
+saves with tf.train.Saver every SAVE_EVERY_EPOCHS epochs keeping
+MAX_TO_KEEP=10, writes a vocab sidecar next to the checkpoint so `--load`
+needs no dataset, and `--release` strips optimizer state. Here:
+
+  <ckpt_dir>/
+    step_<N>/state/      orbax pytree: params (+ opt_state + step unless released)
+    vocab.pkl            Code2VecVocabs sidecar
+    manifest.json        ModelDims + softmax config (to rebuild the model
+                         without a dataset)
+
+Checkpoints restore with the caller-provided sharding template, so a
+checkpoint written on one mesh reloads onto another (or a single chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dirs(ckpt_dir: str):
+    out = []
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    return sorted(out)
+
+
+def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
+                    vocabs: Code2VecVocabs, dims: ModelDims,
+                    extra_manifest: Optional[Dict[str, Any]] = None,
+                    max_to_keep: int = 10) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"step_{step}", "state")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), state, force=True)
+    vocabs.save(os.path.join(ckpt_dir, "vocab.pkl"))
+    manifest = {
+        "token_vocab_size": dims.token_vocab_size,
+        "path_vocab_size": dims.path_vocab_size,
+        "target_vocab_size": dims.target_vocab_size,
+        "embeddings_size": dims.embeddings_size,
+        "max_contexts": dims.max_contexts,
+        "dropout_keep_rate": dims.dropout_keep_rate,
+        "vocab_pad_multiple": dims.vocab_pad_multiple,
+        "step": step,
+    }
+    if extra_manifest:
+        manifest.update(extra_manifest)
+    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    # Retention: keep the newest `max_to_keep` step dirs (reference
+    # MAX_TO_KEEP=10 semantics).
+    steps = _step_dirs(ckpt_dir)
+    for _s, d in steps[:-max_to_keep]:
+        shutil.rmtree(d, ignore_errors=True)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = _step_dirs(ckpt_dir)
+    return steps[-1][0] if steps else None
+
+
+def load_manifest(ckpt_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        return json.load(f)
+
+
+def load_dims(ckpt_dir: str) -> ModelDims:
+    m = load_manifest(ckpt_dir)
+    return ModelDims(
+        token_vocab_size=m["token_vocab_size"],
+        path_vocab_size=m["path_vocab_size"],
+        target_vocab_size=m["target_vocab_size"],
+        embeddings_size=m["embeddings_size"],
+        max_contexts=m["max_contexts"],
+        dropout_keep_rate=m["dropout_keep_rate"],
+        vocab_pad_multiple=m.get("vocab_pad_multiple", 1),
+    )
+
+
+def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
+                    step: Optional[int] = None) -> Dict[str, Any]:
+    """Restore the pytree at `step` (default: latest) with the dtype /
+    sharding layout of `template` (abstract arrays are fine)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}", "state")
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                      template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), abstract)
+
+
+def load_vocabs(ckpt_dir: str) -> Code2VecVocabs:
+    return Code2VecVocabs.load(os.path.join(ckpt_dir, "vocab.pkl"))
+
+
+def release_checkpoint(load_dir: str, dest_dir: str,
+                       params: Dict[str, Any]) -> None:
+    """Reference `--release` (SURVEY.md §4.5): write a stripped
+    inference-only checkpoint (params, no optimizer slots)."""
+    os.makedirs(dest_dir, exist_ok=True)
+    manifest = load_manifest(load_dir)
+    manifest["released"] = True
+    step = manifest.get("step", 0)
+    path = os.path.join(dest_dir, f"step_{step}", "state")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), {"params": params}, force=True)
+    shutil.copy(os.path.join(load_dir, "vocab.pkl"),
+                os.path.join(dest_dir, "vocab.pkl"))
+    with open(os.path.join(dest_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)

@@ -1,0 +1,127 @@
+"""Jit-compiled train / eval / predict steps.
+
+Reference parity target: the three graphs of `tensorflow_model.py`
+(SURVEY.md §3: `_build_tf_training_graph`, `_build_tf_testing_graph`,
+`_build_tf_predict_graph`) — here they are three pure functions closed
+over static ModelDims and jitted once each. Everything inside is
+XLA-friendly: static shapes, no data-dependent control flow
+(SURVEY.md "XLA semantics").
+
+The same step functions serve single-chip and mesh runs: SPMD sharding is
+carried by the INPUTS (params/batch placed with NamedSharding by
+parallel/sharding.py), and jit's "computation follows sharding" does the
+partitioning — gradient allreduce over 'data' and table-sharded gathers
+over 'model' are inserted by XLA, not hand-written collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from code2vec_tpu.models.encoder import (ModelDims, encode, full_logits)
+from code2vec_tpu.ops.sampled_softmax import sampled_softmax_loss
+
+
+def _weighted_mean(values: jax.Array, weights: jax.Array) -> jax.Array:
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(values * weights) / denom
+
+
+def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
+                    *, use_sampled_softmax: bool = False,
+                    num_sampled: int = 4096,
+                    compute_dtype=jnp.float32) -> Callable:
+    """Returns jitted `step(params, opt_state, batch, rng) ->
+    (params, opt_state, loss)` where batch is a 6-tuple of arrays
+    (labels [B], src/path/dst ids [B, C], mask [B, C],
+    example_weights [B])."""
+
+    def loss_fn(params, labels, src, pth, dst, mask, weights, rng):
+        drop_rng, sample_rng = jax.random.split(rng)
+        code, _attn = encode(
+            params, src, pth, dst, mask, dropout_rng=drop_rng,
+            dropout_keep_rate=dims.dropout_keep_rate,
+            compute_dtype=compute_dtype)
+        if use_sampled_softmax:
+            loss, _ = sampled_softmax_loss(
+                params["target_emb"], code, labels, sample_rng,
+                num_sampled, example_weights=weights,
+                vocab_size=dims.target_vocab_size)
+        else:
+            logits = full_logits(params, code, dims.target_vocab_size)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels)
+            loss = _weighted_mean(ce, weights)
+        return loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        labels, src, pth, dst, mask, weights = batch
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, labels, src, pth, dst, mask, weights, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_eval_step(dims: ModelDims, *, top_k: int = 10,
+                   compute_dtype=jnp.float32) -> Callable:
+    """Returns jitted `step(params, batch) -> (loss_sum, topk_ids,
+    topk_probs)`; no dropout (SURVEY.md §4.3)."""
+
+    @jax.jit
+    def step(params, batch):
+        labels, src, pth, dst, mask, weights = batch
+        code, _attn = encode(params, src, pth, dst, mask,
+                             compute_dtype=compute_dtype)
+        logits = full_logits(params, code, dims.target_vocab_size)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        loss_sum = jnp.sum(ce * weights)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_probs, topk_ids = jax.lax.top_k(probs, top_k)
+        return loss_sum, topk_ids, topk_probs
+
+    return step
+
+
+def make_encode_step(dims: ModelDims, *,
+                     compute_dtype=jnp.float32) -> Callable:
+    """Returns jitted `step(params, batch) -> code_vectors [B, D] f32` —
+    encoder only, no [B, V] logits matmul. Used by --export_code_vectors
+    over a whole test split, where top-k/softmax would be wasted FLOPs."""
+
+    @jax.jit
+    def step(params, batch):
+        _labels, src, pth, dst, mask, _weights = batch
+        code, _attn = encode(params, src, pth, dst, mask,
+                             compute_dtype=compute_dtype)
+        return code.astype(jnp.float32)
+
+    return step
+
+
+def make_predict_step(dims: ModelDims, *, top_k: int = 10,
+                      compute_dtype=jnp.float32) -> Callable:
+    """Returns jitted `step(params, batch) -> (topk_ids, topk_probs,
+    attention, code_vectors)` — the predict graph additionally surfaces
+    per-context attention and the code vector (SURVEY.md §4.4,
+    interpretability output + --export_code_vectors)."""
+
+    @jax.jit
+    def step(params, batch):
+        _labels, src, pth, dst, mask, _weights = batch
+        code, attn = encode(params, src, pth, dst, mask,
+                            compute_dtype=compute_dtype)
+        logits = full_logits(params, code, dims.target_vocab_size)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_probs, topk_ids = jax.lax.top_k(probs, top_k)
+        return topk_ids, topk_probs, attn, code.astype(jnp.float32)
+
+    return step

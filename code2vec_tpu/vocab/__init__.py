@@ -1,0 +1,2 @@
+from code2vec_tpu.vocab.vocabularies import (  # noqa: F401
+    Vocab, VocabType, Code2VecVocabs)

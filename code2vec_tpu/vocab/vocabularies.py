@@ -1,0 +1,154 @@
+"""Vocabulary runtime.
+
+Reference parity target: `vocabularies.py` (SURVEY.md §2 L2, §3):
+`Code2VecVocabs`, `Vocab`, `VocabType.{Token,Target,Path}`, special words
+PAD/OOV, word<->index lookup. Loads the pickled `.dict.c2v` histogram file
+written by preprocessing (format: token-count dict, path-count dict,
+target-count dict, num_training_examples — SURVEY.md §3.2), cuts each
+histogram to its configured max size by descending frequency, and builds
+index maps.
+
+TPU-first note: there is no tf.lookup table here — lookup happens on the
+host (numpy vectorized via python dict; hot path uses pre-binarized shards,
+see data/binarize.py) and the device only ever sees fixed-shape int32
+tensors.
+"""
+
+from __future__ import annotations
+
+import enum
+import pickle
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from code2vec_tpu.common import SpecialVocabWords
+
+
+class VocabType(enum.Enum):
+    Token = 1
+    Target = 2
+    Path = 3
+
+
+class Vocab:
+    """A word<->index bijection with PAD=0 and OOV=1 reserved."""
+
+    SPECIAL_WORDS: Tuple[str, ...] = (SpecialVocabWords.PAD,
+                                      SpecialVocabWords.OOV)
+
+    def __init__(self, vocab_type: VocabType, words: Iterable[str]):
+        self.vocab_type = vocab_type
+        self.word_to_index: Dict[str, int] = {}
+        self.index_to_word: Dict[int, str] = {}
+        for word in self.SPECIAL_WORDS:
+            self._add(word)
+        for word in words:
+            if word not in self.word_to_index:
+                self._add(word)
+
+    def _add(self, word: str) -> None:
+        idx = len(self.word_to_index)
+        self.word_to_index[word] = idx
+        self.index_to_word[idx] = word
+
+    @property
+    def size(self) -> int:
+        return len(self.word_to_index)
+
+    @property
+    def pad_index(self) -> int:
+        return self.word_to_index[SpecialVocabWords.PAD]
+
+    @property
+    def oov_index(self) -> int:
+        return self.word_to_index[SpecialVocabWords.OOV]
+
+    def lookup_index(self, word: str) -> int:
+        return self.word_to_index.get(word, self.oov_index)
+
+    def lookup_word(self, index: int) -> str:
+        return self.index_to_word.get(index, SpecialVocabWords.OOV)
+
+    @classmethod
+    def create_from_freq_dict(cls, vocab_type: VocabType,
+                              freq_dict: Dict[str, int],
+                              max_size: int) -> "Vocab":
+        """Keep the `max_size` most frequent words (ties broken by
+        insertion order, matching Counter.most_common semantics)."""
+        words = [w for w, _ in sorted(freq_dict.items(),
+                                      key=lambda kv: (-kv[1],))][:max_size]
+        return cls(vocab_type, words)
+
+    # ---- (de)serialization: list of words in index order, specials first ----
+    def to_word_list(self) -> List[str]:
+        return [self.index_to_word[i] for i in range(self.size)]
+
+    @classmethod
+    def from_word_list(cls, vocab_type: VocabType,
+                       words: List[str]) -> "Vocab":
+        assert tuple(words[:len(cls.SPECIAL_WORDS)]) == cls.SPECIAL_WORDS, \
+            "corrupt vocab: special words missing from head"
+        return cls(vocab_type, words[len(cls.SPECIAL_WORDS):])
+
+
+class Code2VecVocabs:
+    """The three vocabularies (token / path / target) used by the model."""
+
+    def __init__(self, token_vocab: Vocab, path_vocab: Vocab,
+                 target_vocab: Vocab,
+                 num_training_examples: Optional[int] = None):
+        self.token_vocab = token_vocab
+        self.path_vocab = path_vocab
+        self.target_vocab = target_vocab
+        self.num_training_examples = num_training_examples
+
+    def get(self, vocab_type: VocabType) -> Vocab:
+        return {VocabType.Token: self.token_vocab,
+                VocabType.Path: self.path_vocab,
+                VocabType.Target: self.target_vocab}[vocab_type]
+
+    @classmethod
+    def load_from_dict_file(cls, dict_path: str, max_token_vocab_size: int,
+                            max_path_vocab_size: int,
+                            max_target_vocab_size: int) -> "Code2VecVocabs":
+        """Load the `.dict.c2v` pickle written by preprocess
+        (SURVEY.md §3.2: token dict, path dict, target dict, num_examples,
+        pickled sequentially in that order)."""
+        with open(dict_path, "rb") as f:
+            token_counts = pickle.load(f)
+            path_counts = pickle.load(f)
+            target_counts = pickle.load(f)
+            try:
+                num_examples = pickle.load(f)
+            except EOFError:
+                num_examples = None
+        return cls(
+            Vocab.create_from_freq_dict(VocabType.Token, token_counts,
+                                        max_token_vocab_size),
+            Vocab.create_from_freq_dict(VocabType.Path, path_counts,
+                                        max_path_vocab_size),
+            Vocab.create_from_freq_dict(VocabType.Target, target_counts,
+                                        max_target_vocab_size),
+            num_training_examples=num_examples,
+        )
+
+    # ---- checkpoint sidecar (SURVEY.md §3.2 "Model checkpoint": vocab
+    # saved next to the model so --load needs no dataset) ----
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({
+                "token": self.token_vocab.to_word_list(),
+                "path": self.path_vocab.to_word_list(),
+                "target": self.target_vocab.to_word_list(),
+                "num_training_examples": self.num_training_examples,
+            }, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Code2VecVocabs":
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        return cls(
+            Vocab.from_word_list(VocabType.Token, d["token"]),
+            Vocab.from_word_list(VocabType.Path, d["path"]),
+            Vocab.from_word_list(VocabType.Target, d["target"]),
+            num_training_examples=d.get("num_training_examples"),
+        )

@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax import so
+multi-chip sharding tests run without TPU hardware (SURVEY.md §5
+"multi-node without a cluster")."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's site customization (PYTHONPATH=/root/.axon_site) may
+# have imported jax already with the axon TPU platform; force CPU via the
+# config API too (env var alone is not enough in that case).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,70 @@
+"""Shared test fixtures: tiny synthetic extractor output + dataset build."""
+
+from __future__ import annotations
+
+import os
+import random
+
+from code2vec_tpu.data import binarize as binarize_mod
+from code2vec_tpu.data import preprocess as preprocess_mod
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+TOKENS = ["foo", "bar", "baz", "qux", "value", "name", "index", "count"]
+PATHS = [str(h) for h in (123456, -98765, 424242, 1337, -777, 31415)]
+TARGETS = ["get|value", "set|value", "get|name", "set|name", "add|item",
+           "remove|item", "to|string", "is|empty"]
+
+
+def make_raw_lines(n: int, seed: int = 0, max_ctx: int = 12):
+    """Synthetic extractor-format lines: `target tok,path,tok ...` where
+    the target is (weakly) recoverable from the contexts: target class k
+    biases which tokens/paths appear."""
+    rng = random.Random(seed)
+    lines = []
+    for _ in range(n):
+        t_idx = rng.randrange(len(TARGETS))
+        target = TARGETS[t_idx]
+        n_ctx = rng.randint(1, max_ctx)
+        ctxs = []
+        for _ in range(n_ctx):
+            # bias token/path choice by the target class so the model can
+            # actually learn the mapping
+            tok_a = TOKENS[(t_idx + rng.randrange(2)) % len(TOKENS)]
+            tok_b = TOKENS[(t_idx * 3 + rng.randrange(2)) % len(TOKENS)]
+            path = PATHS[t_idx % len(PATHS)] if rng.random() < 0.7 \
+                else rng.choice(PATHS)
+            ctxs.append(f"{tok_a},{path},{tok_b}")
+        lines.append(target + " " + " ".join(ctxs))
+    return lines
+
+
+def build_tiny_dataset(tmpdir: str, n_train: int = 256, n_val: int = 32,
+                       n_test: int = 64, max_contexts: int = 16,
+                       binarize: bool = False) -> str:
+    """Write raw lines, run preprocess (+ optional binarize); returns the
+    dataset prefix."""
+    raw_train = os.path.join(tmpdir, "raw.train.txt")
+    raw_val = os.path.join(tmpdir, "raw.val.txt")
+    raw_test = os.path.join(tmpdir, "raw.test.txt")
+    for path, n, seed in ((raw_train, n_train, 1), (raw_val, n_val, 2),
+                          (raw_test, n_test, 3)):
+        with open(path, "w") as f:
+            f.write("\n".join(make_raw_lines(n, seed=seed)) + "\n")
+    prefix = os.path.join(tmpdir, "tiny")
+    preprocess_mod.main([
+        "--train_data", raw_train, "--val_data", raw_val,
+        "--test_data", raw_test, "--max_contexts", str(max_contexts),
+        "--word_vocab_size", "1000", "--path_vocab_size", "1000",
+        "--target_vocab_size", "1000", "--output_name", prefix])
+    if binarize:
+        binarize_mod.main(["--data", prefix,
+                           "--max_contexts", str(max_contexts),
+                           "--word_vocab_size", "1000",
+                           "--path_vocab_size", "1000",
+                           "--target_vocab_size", "1000"])
+    return prefix
+
+
+def load_tiny_vocabs(prefix: str) -> Code2VecVocabs:
+    return Code2VecVocabs.load_from_dict_file(
+        prefix + ".dict.c2v", 1000, 1000, 1000)

@@ -1,0 +1,64 @@
+"""Unit tests for subtokenization / normalization / metric primitives
+(SURVEY.md §5: "subtokenization/normalization parity ... F1 computation
+against hand-computed cases")."""
+
+from code2vec_tpu.common import (SpecialVocabWords, SubtokenStatistics,
+                                 calculate_subtoken_tp_fp_fn,
+                                 filter_impossible_names, get_subtokens,
+                                 legal_method_names_checker, normalize_word,
+                                 split_to_subtokens)
+
+
+def test_normalize_word():
+    assert normalize_word("Foo") == "foo"
+    assert normalize_word("foo123") == "foo"
+    assert normalize_word("123") == "123"  # all-stripped falls back to lower
+    assert normalize_word("FOO_BAR") == "foobar"
+    assert normalize_word("") == ""
+
+
+def test_split_to_subtokens():
+    assert split_to_subtokens("setFooBar") == ["set", "foo", "bar"]
+    assert split_to_subtokens("set_foo_bar") == ["set", "foo", "bar"]
+    assert split_to_subtokens("HTMLParser") == ["html", "parser"]
+    assert split_to_subtokens("value2x") == ["value", "x"]
+    assert split_to_subtokens("  trim  ") == ["trim"]
+
+
+def test_get_subtokens():
+    assert get_subtokens("set|name") == ["set", "name"]
+    assert get_subtokens("toString") == ["toString"]
+    assert get_subtokens("") == []
+
+
+def test_legal_method_names():
+    assert legal_method_names_checker("get|value")
+    assert not legal_method_names_checker(SpecialVocabWords.OOV)
+    assert not legal_method_names_checker(SpecialVocabWords.PAD)
+    assert not legal_method_names_checker("")
+    assert not legal_method_names_checker("|||")
+    assert filter_impossible_names(
+        ["<OOV>", "get|x", "<PAD>"]) == ["get|x"]
+
+
+def test_subtoken_tp_fp_fn_hand_cases():
+    # exact match
+    assert calculate_subtoken_tp_fp_fn("get|name", "get|name") == (2, 0, 0)
+    # partial: predicted {get,value}, true {get,name}
+    assert calculate_subtoken_tp_fp_fn("get|name", "get|value") == (1, 1, 1)
+    # empty prediction
+    assert calculate_subtoken_tp_fp_fn("get|name", "") == (0, 0, 2)
+    # extra subtokens
+    assert calculate_subtoken_tp_fp_fn("run", "run|fast|now") == (1, 2, 0)
+
+
+def test_subtoken_statistics_f1():
+    st = SubtokenStatistics()
+    st.update("get|name", "get|value")  # tp1 fp1 fn1
+    st.update("set|x", "set|x")         # +tp2
+    assert st.true_positive == 3
+    assert st.false_positive == 1
+    assert st.false_negative == 1
+    assert abs(st.precision - 3 / 4) < 1e-9
+    assert abs(st.recall - 3 / 4) < 1e-9
+    assert abs(st.f1 - 0.75) < 1e-9

@@ -1,0 +1,98 @@
+"""Masked-attention math vs a numpy oracle and sampled-vs-full softmax
+agreement on tiny vocabs (SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.ops.sampled_softmax import (log_uniform_sample,
+                                              sampled_softmax_loss)
+
+
+def _numpy_attention_oracle(contexts, transform, attention, mask):
+    transformed = np.tanh(contexts @ transform)
+    scores = transformed @ attention
+    scores = np.where(mask > 0, scores, -1e9)
+    e = np.exp(scores - scores.max(axis=-1, keepdims=True))
+    attn = e / e.sum(axis=-1, keepdims=True)
+    attn = np.where(mask.sum(-1, keepdims=True) > 0, attn, 0.0)
+    code = np.einsum("bc,bcd->bd", attn, transformed)
+    return code, attn
+
+
+def test_attention_pool_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    B, C, D = 4, 6, 8
+    contexts = rng.normal(size=(B, C, D)).astype(np.float32)
+    transform = rng.normal(size=(D, D)).astype(np.float32) * 0.3
+    attention = rng.normal(size=(D,)).astype(np.float32)
+    mask = (rng.random((B, C)) > 0.3).astype(np.float32)
+    mask[0] = 1.0   # fully valid row
+    mask[1] = 0.0   # fully padded row
+    code, attn = attention_pool(jnp.asarray(contexts), jnp.asarray(transform),
+                                jnp.asarray(attention), jnp.asarray(mask))
+    code_np, attn_np = _numpy_attention_oracle(contexts, transform,
+                                               attention, mask)
+    np.testing.assert_allclose(np.asarray(attn), attn_np, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(code), code_np, atol=1e-5)
+    # attention is a distribution over valid positions
+    np.testing.assert_allclose(np.asarray(attn).sum(-1)[0], 1.0, atol=1e-5)
+    assert np.asarray(attn)[1].sum() == 0.0
+    # padded positions get zero weight
+    assert np.all(np.asarray(attn)[mask == 0] < 1e-6)
+
+
+def test_log_uniform_sampler_distribution():
+    V = 100
+    ids = np.asarray(log_uniform_sample(jax.random.PRNGKey(0), 200_000, V))
+    assert ids.min() >= 0 and ids.max() < V
+    freq = np.bincount(ids, minlength=V) / len(ids)
+    expected = np.log((np.arange(V) + 2) / (np.arange(V) + 1)) / np.log(V + 1)
+    # Zipfian head should dominate and match the analytic pmf closely
+    np.testing.assert_allclose(freq[:10], expected[:10], rtol=0.05)
+    assert freq[0] > freq[10] > freq[50]
+
+
+def test_sampled_softmax_close_to_full_softmax_on_tiny_vocab():
+    """With S comparable to V, the corrected sampled loss should be close
+    to the full-softmax CE (consistency of the estimator)."""
+    rng = np.random.default_rng(1)
+    V, D, B, S = 50, 16, 64, 40
+    table = rng.normal(size=(V, D)).astype(np.float32) * 0.1
+    code = rng.normal(size=(B, D)).astype(np.float32)
+    labels = rng.integers(0, V, size=(B,)).astype(np.int32)
+
+    logits = code @ table.T
+    full_ce = float(np.mean(
+        np.log(np.exp(logits).sum(-1)) - logits[np.arange(B), labels]))
+
+    losses = []
+    for seed in range(30):
+        loss, _ = sampled_softmax_loss(
+            jnp.asarray(table), jnp.asarray(code), jnp.asarray(labels),
+            jax.random.PRNGKey(seed), S)
+        losses.append(float(loss))
+    mean_sampled = np.mean(losses)
+    # estimator is biased low for small S; just require the right scale
+    assert 0.5 * full_ce < mean_sampled < 1.5 * full_ce
+
+
+def test_sampled_softmax_padded_examples_excluded():
+    rng = np.random.default_rng(2)
+    V, D, B, S = 20, 8, 8, 10
+    table = rng.normal(size=(V, D)).astype(np.float32)
+    code = rng.normal(size=(B, D)).astype(np.float32)
+    labels = np.zeros((B,), dtype=np.int32)
+    w_half = np.array([1, 1, 1, 1, 0, 0, 0, 0], dtype=np.float32)
+    loss_half, _ = sampled_softmax_loss(
+        jnp.asarray(table), jnp.asarray(code), jnp.asarray(labels),
+        jax.random.PRNGKey(0), S, example_weights=jnp.asarray(w_half))
+    # same valid examples, garbage rows changed -> loss unchanged
+    code2 = code.copy()
+    code2[4:] = 1e3
+    loss_half2, _ = sampled_softmax_loss(
+        jnp.asarray(table), jnp.asarray(code2), jnp.asarray(labels),
+        jax.random.PRNGKey(0), S, example_weights=jnp.asarray(w_half))
+    np.testing.assert_allclose(float(loss_half), float(loss_half2),
+                               rtol=1e-5)

@@ -1,0 +1,99 @@
+"""Sharded == single-device numerics on the 8-device virtual CPU mesh
+(SURVEY.md §5 '"Multi-node without a cluster"')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from code2vec_tpu.models.encoder import ModelDims, encode, full_logits, \
+    init_params
+from code2vec_tpu.parallel.mesh import make_mesh
+from code2vec_tpu.parallel.sharding import (param_pspecs, shard_batch,
+                                            shard_opt_state, shard_params)
+from code2vec_tpu.training.steps import make_train_step
+
+DIMS = ModelDims(token_vocab_size=32, path_vocab_size=24,
+                 target_vocab_size=20, embeddings_size=8, max_contexts=6,
+                 dropout_keep_rate=1.0, vocab_pad_multiple=2)
+
+
+def _batch(rng, b=16):
+    r = np.random.default_rng(rng)
+    labels = r.integers(0, DIMS.target_vocab_size, size=(b,), dtype=np.int32)
+    src = r.integers(0, DIMS.token_vocab_size, size=(b, 6), dtype=np.int32)
+    pth = r.integers(0, DIMS.path_vocab_size, size=(b, 6), dtype=np.int32)
+    dst = r.integers(0, DIMS.token_vocab_size, size=(b, 6), dtype=np.int32)
+    mask = np.ones((b, 6), dtype=np.float32)
+    weights = np.ones((b,), dtype=np.float32)
+    return labels, src, pth, dst, mask, weights
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(0, 2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(3, 3)
+
+
+def test_sharded_train_step_matches_single_device():
+    assert len(jax.devices()) == 8
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    opt = optax.adam(0.01)
+    opt_state = opt.init(params)
+    batch = _batch(0)
+    rng = jax.random.PRNGKey(1)
+
+    # single-device reference run
+    step1 = make_train_step(DIMS, opt)
+    p1, os1, loss1 = step1(
+        jax.tree_util.tree_map(jnp.copy, params), opt.init(params),
+        tuple(jnp.asarray(a) for a in batch), rng)
+
+    # sharded run: params over ('model',), batch over ('data',)
+    mesh = make_mesh(0, 2)
+    sp = shard_params(mesh, params)
+    so = shard_opt_state(mesh, opt_state, sp)
+    sb = shard_batch(mesh, batch)
+    step2 = make_train_step(DIMS, opt)
+    p2, os2, loss2 = step2(sp, so, sb, rng)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   atol=1e-5, err_msg=k)
+    # table sharding actually happened
+    specs = param_pspecs()
+    assert ("model" in str(p2["token_emb"].sharding)
+            or p2["token_emb"].sharding.is_fully_replicated is False)
+
+
+def test_sharded_sampled_softmax_matches_single_device():
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    opt = optax.adam(0.01)
+    batch = _batch(1)
+    rng = jax.random.PRNGKey(2)
+    step = make_train_step(DIMS, opt, use_sampled_softmax=True,
+                           num_sampled=8)
+    _, _, loss1 = step(jax.tree_util.tree_map(jnp.copy, params),
+                       opt.init(params),
+                       tuple(jnp.asarray(a) for a in batch), rng)
+    mesh = make_mesh(0, 2)
+    sp = shard_params(mesh, params)
+    so = shard_opt_state(mesh, opt.init(params), sp)
+    sb = shard_batch(mesh, batch)
+    step2 = make_train_step(DIMS, opt, use_sampled_softmax=True,
+                            num_sampled=8)
+    _, _, loss2 = step2(sp, so, sb, rng)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+
+
+def test_vocab_row_padding_for_model_axis():
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    assert params["token_emb"].shape[0] % 2 == 0
+    assert params["target_emb"].shape[0] % 2 == 0
+    # padded logit rows are masked out of top-k
+    code = jnp.ones((2, DIMS.code_vector_size))
+    logits = full_logits(params, code, DIMS.target_vocab_size)
+    assert np.all(np.asarray(logits)[:, DIMS.target_vocab_size:] < -1e8)

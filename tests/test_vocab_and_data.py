@@ -1,0 +1,124 @@
+"""Vocab cut/lookup, preprocess round-trip, reader parsing edge cases
+(SURVEY.md §5: "vocab cut/lookup, reader parsing of hand-written .c2v rows
+incl. padding/mask edge cases: 0 contexts, >max contexts, OOV")."""
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.reader import (BinaryShardReader, C2VTextReader,
+                                      parse_c2v_rows)
+from code2vec_tpu.vocab.vocabularies import (Code2VecVocabs, Vocab,
+                                             VocabType)
+from tests.helpers import build_tiny_dataset, load_tiny_vocabs
+
+
+def test_vocab_specials_and_cut():
+    v = Vocab.create_from_freq_dict(
+        VocabType.Token, {"a": 5, "b": 3, "c": 10, "d": 1}, max_size=2)
+    assert v.pad_index == 0 and v.oov_index == 1
+    # top-2 by frequency: c, a
+    assert v.lookup_index("c") == 2
+    assert v.lookup_index("a") == 3
+    assert v.lookup_index("b") == v.oov_index  # cut
+    assert v.lookup_word(2) == "c"
+    assert v.size == 4
+
+
+def test_vocab_word_list_roundtrip():
+    v = Vocab.create_from_freq_dict(VocabType.Target,
+                                    {"get|x": 3, "set|x": 1}, 10)
+    v2 = Vocab.from_word_list(VocabType.Target, v.to_word_list())
+    assert v2.word_to_index == v.word_to_index
+
+
+def test_preprocess_and_dict_roundtrip(tmp_path):
+    prefix = build_tiny_dataset(str(tmp_path), n_train=50, n_val=8,
+                                n_test=8, max_contexts=10)
+    vocabs = load_tiny_vocabs(prefix)
+    assert vocabs.num_training_examples == 50
+    # every .c2v row has exactly 1 + max_contexts space-separated fields
+    with open(prefix + ".train.c2v") as f:
+        for line in f:
+            assert len(line.rstrip("\n").split(" ")) == 11
+
+
+def test_parse_c2v_rows_edge_cases():
+    vocabs = Code2VecVocabs(
+        Vocab(VocabType.Token, ["foo", "bar"]),
+        Vocab(VocabType.Path, ["111", "222"]),
+        Vocab(VocabType.Target, ["get|x"]))
+    lines = [
+        "get|x foo,111,bar bar,222,foo",          # 2 contexts
+        "unknown|name ",                           # 0 contexts, OOV target
+        "get|x oov_tok,999,foo",                   # OOV token+path
+    ]
+    labels, src, pth, dst, mask, _, _ = parse_c2v_rows(
+        lines, vocabs, max_contexts=4)
+    tv, pv = vocabs.token_vocab, vocabs.path_vocab
+    assert labels[0] == vocabs.target_vocab.lookup_index("get|x")
+    assert labels[1] == vocabs.target_vocab.oov_index
+    assert mask[0].tolist() == [1.0, 1.0, 0.0, 0.0]
+    assert mask[1].tolist() == [0.0, 0.0, 0.0, 0.0]
+    assert src[0, 0] == tv.lookup_index("foo")
+    assert pth[0, 1] == pv.lookup_index("222")
+    assert src[2, 0] == tv.oov_index
+    assert pth[2, 0] == pv.oov_index
+    # padding positions hold PAD
+    assert src[0, 2] == tv.pad_index and pth[1, 0] == pv.pad_index
+
+
+def test_row_longer_than_max_contexts_truncates():
+    vocabs = Code2VecVocabs(
+        Vocab(VocabType.Token, ["a"]), Vocab(VocabType.Path, ["1"]),
+        Vocab(VocabType.Target, ["t"]))
+    line = "t " + " ".join(["a,1,a"] * 10)
+    _, src, _, _, mask, _, _ = parse_c2v_rows([line], vocabs, max_contexts=4)
+    assert mask.shape == (1, 4)
+    assert mask.sum() == 4
+
+
+def test_text_reader_batching_and_final_pad(tmp_path):
+    prefix = build_tiny_dataset(str(tmp_path), n_train=10, n_val=2,
+                                n_test=2, max_contexts=8)
+    vocabs = load_tiny_vocabs(prefix)
+    reader = C2VTextReader(prefix + ".train.c2v", vocabs, 8, batch_size=4)
+    batches = list(reader)
+    assert len(batches) == 3
+    assert all(b.target_index.shape == (4,) for b in batches)
+    assert batches[-1].num_valid_examples == 2
+    # padded tail rows are masked out entirely
+    assert batches[-1].context_valid_mask[2:].sum() == 0
+
+
+def test_binary_reader_matches_text_reader(tmp_path):
+    prefix = build_tiny_dataset(str(tmp_path), n_train=32, n_val=4,
+                                n_test=4, max_contexts=8, binarize=True)
+    vocabs = load_tiny_vocabs(prefix)
+    text = list(C2VTextReader(prefix + ".train.c2v", vocabs, 8,
+                              batch_size=8))
+    binary = list(BinaryShardReader(prefix + ".train", batch_size=8))
+    assert len(text) == len(binary)
+    for tb, bb in zip(text, binary):
+        np.testing.assert_array_equal(tb.target_index, bb.target_index)
+        np.testing.assert_array_equal(tb.path_indices, bb.path_indices)
+        np.testing.assert_array_equal(tb.path_source_token_indices,
+                                      bb.path_source_token_indices)
+        np.testing.assert_array_equal(tb.context_valid_mask,
+                                      bb.context_valid_mask)
+
+
+def test_reader_shuffle_is_seeded_and_complete(tmp_path):
+    prefix = build_tiny_dataset(str(tmp_path), n_train=16, n_val=2,
+                                n_test=2, max_contexts=8)
+    vocabs = load_tiny_vocabs(prefix)
+    r1 = C2VTextReader(prefix + ".train.c2v", vocabs, 8, batch_size=16,
+                       shuffle=True, seed=7)
+    r2 = C2VTextReader(prefix + ".train.c2v", vocabs, 8, batch_size=16,
+                       shuffle=True, seed=7)
+    b1, b2 = next(iter(r1)), next(iter(r2))
+    np.testing.assert_array_equal(b1.target_index, b2.target_index)
+    # same multiset of labels as unshuffled
+    r3 = C2VTextReader(prefix + ".train.c2v", vocabs, 8, batch_size=16)
+    b3 = next(iter(r3))
+    assert sorted(b1.target_index.tolist()) == sorted(
+        b3.target_index.tolist())

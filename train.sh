@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Train driver — reference-compatible (SURVEY.md §3 "Train driver"):
+# set the dataset name/paths, invoke code2vec.py. Runs unchanged on the
+# TPU backend.
+set -euo pipefail
+
+type=${type:-java-small}
+dataset_name=${dataset_name:-${type}}
+data_dir=${data_dir:-data}
+data=${data_dir}/${dataset_name}/${dataset_name}
+test_data=${data_dir}/${dataset_name}/${dataset_name}.val.c2v
+model_dir=${model_dir:-models/${dataset_name}}
+
+mkdir -p "${model_dir}"
+set -x
+python3 code2vec.py --data "${data}" --test "${test_data}" \
+  --save "${model_dir}/saved_model" --backend "${backend:-tpu}" "$@"
