@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Benchmark: training throughput of the java-large config on one chip.
+
+Prints ONE JSON line:
+  {"metric": "path-contexts/sec/chip", "value": N, "unit": "...",
+   "vs_baseline": N}
+
+Metric (BASELINE.json): path-contexts/sec/chip on java-large =
+examples/sec * MAX_CONTEXTS(200), measured over the jitted training step
+(sampled softmax over the 261K-name target vocab — the north-star
+java-large configuration; full vocab tables at reference capacity).
+
+Baseline denominator: BASELINE.md records no published reference
+throughput (empty mount; see SURVEY.md §7). We use an estimated
+single-V100 TF1 reference throughput of 3500 examples/s (700_000
+path-contexts/s) — community-reported magnitude for code2vec's TF training
+at batch 1024 on V100; re-verify when the reference runs
+(BASELINE.md action item 2).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V100_BASELINE_PATH_CONTEXTS_PER_SEC = 700_000.0
+
+# java-large capacities (SURVEY.md §3 config row)
+TOKEN_VOCAB = 1_301_136
+PATH_VOCAB = 911_417
+TARGET_VOCAB = 261_245
+BATCH = 1024
+MAX_CONTEXTS = 200
+NUM_SAMPLED = 4096
+WARMUP_STEPS = 5
+MEASURE_STEPS = 40
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.training.steps import make_train_step
+
+    dims = ModelDims(token_vocab_size=TOKEN_VOCAB,
+                     path_vocab_size=PATH_VOCAB,
+                     target_vocab_size=TARGET_VOCAB,
+                     embeddings_size=128, max_contexts=MAX_CONTEXTS)
+    params = init_params(jax.random.PRNGKey(0), dims)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step = make_train_step(dims, optimizer, use_sampled_softmax=True,
+                           num_sampled=NUM_SAMPLED,
+                           compute_dtype=jnp.bfloat16)
+
+    r = np.random.default_rng(0)
+    def batch_for(i):
+        labels = r.integers(0, TARGET_VOCAB, size=(BATCH,), dtype=np.int32)
+        src = r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                         dtype=np.int32)
+        pth = r.integers(0, PATH_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                         dtype=np.int32)
+        dst = r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                         dtype=np.int32)
+        mask = np.ones((BATCH, MAX_CONTEXTS), dtype=np.float32)
+        weights = np.ones((BATCH,), dtype=np.float32)
+        return tuple(jnp.asarray(a) for a in
+                     (labels, src, pth, dst, mask, weights))
+
+    rng = jax.random.PRNGKey(1)
+    # a few distinct host batches so we're not timing a cached input
+    batches = [batch_for(i) for i in range(4)]
+    for i in range(WARMUP_STEPS):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state,
+                                       batches[i % len(batches)], k)
+    float(loss)  # hard sync; block_until_ready can return early on the
+    # tunneled axon platform, so sync via a host transfer instead
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state,
+                                       batches[i % len(batches)], k)
+    # single hard sync at the end: the donated-params chain serializes all
+    # MEASURE_STEPS steps, so this bounds the full computation
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = MEASURE_STEPS * BATCH / dt
+    value = examples_per_sec * MAX_CONTEXTS
+    print(json.dumps({
+        "metric": "path-contexts/sec/chip",
+        "value": round(value, 1),
+        "unit": "path-contexts/sec/chip (java-large, sampled softmax, "
+                "batch 1024, bf16)",
+        "vs_baseline": round(value / V100_BASELINE_PATH_CONTEXTS_PER_SEC,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

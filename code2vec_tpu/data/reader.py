@@ -108,29 +108,47 @@ class C2VTextReader:
         self.host_shard = host_shard
         self.num_host_shards = num_host_shards
         self._epoch = 0
+        self._offsets: Optional[np.ndarray] = None
+
+    def _line_offsets(self) -> np.ndarray:
+        """Byte offsets of non-empty lines (built once; the file itself is
+        never held in memory — reference-scale .c2v files are tens of GB,
+        so whole-file reads would OOM the host)."""
+        if self._offsets is None:
+            offsets = []
+            with open(self.path, "rb") as f:
+                pos = 0
+                for raw in f:
+                    if raw.strip():
+                        offsets.append(pos)
+                    pos += len(raw)
+            self._offsets = np.asarray(offsets, dtype=np.int64)
+        return self._offsets
 
     def __iter__(self) -> Iterator[BatchTensors]:
-        with open(self.path, "r", encoding="utf-8",
-                  errors="replace") as f:
-            lines = [ln for ln in f if ln.strip()]
-        lines = lines[self.host_shard::self.num_host_shards]
-        order = np.arange(len(lines))
+        offsets = self._line_offsets()[self.host_shard::
+                                       self.num_host_shards]
+        order = np.arange(len(offsets))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(order)
             self._epoch += 1
-        for start in range(0, len(lines), self.batch_size):
-            idx = order[start:start + self.batch_size]
-            batch_lines = [lines[i] for i in idx]
-            labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
-                batch_lines, self.vocabs, self.max_contexts,
-                self.keep_strings)
-            nv = len(batch_lines)
-            labels, src, pth, dst, mask = _pad_batch(
-                (labels, src, pth, dst, mask), self.batch_size)
-            yield BatchTensors(labels, src, pth, dst, mask, nv,
-                               tstr if self.keep_strings else None,
-                               cstr if self.keep_strings else None)
+        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+            for start in range(0, len(offsets), self.batch_size):
+                idx = order[start:start + self.batch_size]
+                batch_lines = []
+                for off in offsets[idx]:
+                    f.seek(off)
+                    batch_lines.append(f.readline())
+                labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
+                    batch_lines, self.vocabs, self.max_contexts,
+                    self.keep_strings)
+                nv = len(batch_lines)
+                labels, src, pth, dst, mask = _pad_batch(
+                    (labels, src, pth, dst, mask), self.batch_size)
+                yield BatchTensors(labels, src, pth, dst, mask, nv,
+                                   tstr if self.keep_strings else None,
+                                   cstr if self.keep_strings else None)
 
 
 class BinaryShardReader:
@@ -140,10 +158,17 @@ class BinaryShardReader:
 
     def __init__(self, prefix: str, batch_size: int, shuffle: bool = False,
                  seed: int = 0, host_shard: int = 0,
-                 num_host_shards: int = 1):
+                 num_host_shards: int = 1,
+                 expected_max_contexts: Optional[int] = None):
         with open(prefix + ".bin.json", "r") as f:
             self.manifest = json.load(f)
         self.max_contexts = int(self.manifest["max_contexts"])
+        if (expected_max_contexts is not None
+                and expected_max_contexts != self.max_contexts):
+            raise ValueError(
+                f"binary shard {prefix}.bin was built with max_contexts="
+                f"{self.max_contexts} but the run requests "
+                f"{expected_max_contexts}; re-binarize or match the flag")
         self.num_examples = int(self.manifest["num_examples"])
         row_width = 1 + 3 * self.max_contexts
         self.data = np.memmap(prefix + ".bin", dtype=np.int32, mode="r",
@@ -193,7 +218,8 @@ def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
     if os.path.exists(prefix + ".bin.json") and not keep_strings:
         return BinaryShardReader(prefix, batch_size, shuffle=shuffle,
                                  seed=seed, host_shard=host_shard,
-                                 num_host_shards=num_host_shards)
+                                 num_host_shards=num_host_shards,
+                                 expected_max_contexts=max_contexts)
     return C2VTextReader(path_or_prefix, vocabs, max_contexts, batch_size,
                          shuffle=shuffle, seed=seed,
                          keep_strings=keep_strings, host_shard=host_shard,

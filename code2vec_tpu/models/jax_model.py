@@ -169,9 +169,9 @@ class Code2VecModel(Code2VecModelBase):
                     window_examples, window_start = 0, time.time()
             if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                 self.save(cfg.save_path)
-                if cfg.is_testing:
-                    results = self.evaluate()
-                    self.log(f"epoch {epoch} evaluation: {results}")
+            if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                results = self.evaluate()
+                self.log(f"epoch {epoch} evaluation: {results}")
         self.log("training done")
 
     # ---- evaluate (SURVEY.md §4.3) ----
@@ -245,6 +245,8 @@ class Code2VecModel(Code2VecModelBase):
 
     # ---- persistence ----
     def save(self, path: Optional[str] = None) -> None:
+        if jax.process_index() != 0:
+            return  # one writer per multi-host job; others would race
         path = path or self.config.save_path
         assert path
         state = {"params": self.params, "opt_state": self.opt_state,

@@ -10,10 +10,20 @@ Semantics implemented (matching TF's defaults):
 - candidates ~ log-uniform over [0, V): P(k) = log((k+2)/(k+1)) / log(V+1),
   so frequency-sorted vocabularies (ours are: Vocab.create_from_freq_dict
   sorts by descending count) get Zipf-like negatives;
+- candidates are UNIQUE (TF's unique=True): drawn via the Gumbel-top-k
+  trick — perturb per-class log-probabilities with Gumbel noise and take
+  the top S, which is distributionally exact sampling without
+  replacement. With replacement the head class (p~0.056 for java-large)
+  would appear ~S*p~230 times and the unique-sampler bias correction
+  would overweight it by orders of magnitude;
 - one shared candidate set per step (TF shares candidates across the batch);
 - bias correction subtracts log(expected_count) from each candidate's and
-  the true class's logits; TF's unique-sampler expectation is
-  E[count] = -expm1(S * log1p(-p));
+  the true class's logits. TF computes -expm1(num_tries * log1p(-p)) with
+  the sampler's actual with-replacement draw count; we use the
+  deterministic equivalent: solve sum_k(-expm1(T*log1p(-p_k))) = S for the
+  effective draw count T once on the host (static per (V, S)) and use
+  inclusion = -expm1(T*log1p(-p)). Verified within ~2% of the empirical
+  Gumbel-top-k inclusion frequencies (tests/test_ops.py);
 - accidental hits (a sampled negative equal to the true label) are masked
   to -inf, as with TF's `remove_accidental_hits=True`.
 
@@ -24,27 +34,64 @@ of S + B rows from the [V, D] target table is the whole point: the dense
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _log_uniform_log_probs(vocab_size: int) -> jax.Array:
+    """Static per-class log-pmf of the log-uniform distribution; XLA
+    constant-folds this inside a jitted step."""
+    k = jnp.arange(vocab_size, dtype=jnp.float32)
+    return jnp.log(jnp.log1p(1.0 / (k + 1.0)) /
+                   jnp.log(float(vocab_size + 1)))
 
 
 def log_uniform_sample(rng: jax.Array, num_sampled: int,
                        vocab_size: int) -> jax.Array:
-    """Draw `num_sampled` class ids (with replacement) from the
-    log-uniform distribution over [0, vocab_size)."""
-    u = jax.random.uniform(rng, (num_sampled,), dtype=jnp.float32)
-    s = jnp.exp(u * jnp.log(float(vocab_size + 1))) - 1.0
-    return jnp.clip(s.astype(jnp.int32), 0, vocab_size - 1)
+    """Draw `num_sampled` UNIQUE class ids from the log-uniform
+    distribution over [0, vocab_size) via Gumbel-top-k (exact sampling
+    without replacement, matching TF's unique=True candidate sampler)."""
+    if num_sampled >= vocab_size:
+        return jnp.arange(vocab_size, dtype=jnp.int32)
+    gumbel = jax.random.gumbel(rng, (vocab_size,), dtype=jnp.float32)
+    scores = _log_uniform_log_probs(vocab_size) + gumbel
+    _, ids = jax.lax.top_k(scores, num_sampled)
+    return ids.astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _effective_num_tries(num_sampled: int, vocab_size: int) -> float:
+    """Deterministic stand-in for TF's stochastic num_tries: the T such
+    that the expected number of distinct classes in T with-replacement
+    log-uniform draws equals num_sampled. Newton's method on the host;
+    cached per static (S, V)."""
+    k = np.arange(vocab_size, dtype=np.float64)
+    log1m_p = np.log1p(-(np.log1p(1.0 / (k + 1.0)) /
+                         np.log(float(vocab_size + 1))))
+    T = float(num_sampled)
+    for _ in range(100):
+        f = np.sum(-np.expm1(T * log1m_p)) - num_sampled
+        df = np.sum(-log1m_p * np.exp(T * log1m_p))
+        step = f / df
+        T -= step
+        if abs(step) < 1e-9:
+            break
+    return T
 
 
 def _log_expected_count(ids: jax.Array, num_sampled: int,
                         vocab_size: int) -> jax.Array:
     k = ids.astype(jnp.float32)
     p = jnp.log1p(1.0 / (k + 1.0)) / jnp.log(float(vocab_size + 1))
-    # TF log_uniform_candidate_sampler(unique=True) expected count:
-    return jnp.log(-jnp.expm1(num_sampled * jnp.log1p(-p)))
+    if num_sampled >= vocab_size:
+        # exhaustive candidate set: every class appears exactly once
+        return jnp.zeros_like(p)
+    T = _effective_num_tries(num_sampled, vocab_size)
+    return jnp.log(-jnp.expm1(T * jnp.log1p(-p)))
 
 
 def sampled_softmax_loss(
@@ -69,6 +116,8 @@ def sampled_softmax_loss(
     """
     if vocab_size is None:
         vocab_size = target_table.shape[0]
+    # S > V degenerates to the exhaustive candidate set (full softmax)
+    num_sampled = min(num_sampled, vocab_size)
     sampled = log_uniform_sample(rng, num_sampled, vocab_size)  # [S]
 
     dtype = code_vectors.dtype

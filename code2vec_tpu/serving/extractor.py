@@ -65,8 +65,12 @@ class Extractor:
             cmd = [self._binary(), "--file", path,
                    "--max_path_length", str(self.max_path_length),
                    "--max_path_width", str(self.max_path_width)]
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=120)
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=120)
+            except subprocess.TimeoutExpired as e:
+                raise ExtractorError(
+                    f"extractor timed out on {path}") from e
             if proc.returncode != 0:
                 raise ExtractorError(
                     f"extractor failed ({proc.returncode}): {proc.stderr}")

@@ -44,14 +44,25 @@ def test_attention_pool_matches_numpy_oracle():
 
 
 def test_log_uniform_sampler_distribution():
-    V = 100
-    ids = np.asarray(log_uniform_sample(jax.random.PRNGKey(0), 200_000, V))
-    assert ids.min() >= 0 and ids.max() < V
-    freq = np.bincount(ids, minlength=V) / len(ids)
-    expected = np.log((np.arange(V) + 2) / (np.arange(V) + 1)) / np.log(V + 1)
-    # Zipfian head should dominate and match the analytic pmf closely
-    np.testing.assert_allclose(freq[:10], expected[:10], rtol=0.05)
-    assert freq[0] > freq[10] > freq[50]
+    """Candidates are unique per draw and their inclusion frequency
+    matches the without-replacement expectation -expm1(S*log1p(-p))."""
+    V, S, TRIALS = 100, 20, 2000
+    counts = np.zeros(V)
+    for seed in range(TRIALS):
+        ids = np.asarray(log_uniform_sample(jax.random.PRNGKey(seed), S, V))
+        assert ids.shape == (S,)
+        assert ids.min() >= 0 and ids.max() < V
+        assert len(np.unique(ids)) == S  # unique=True semantics
+        counts[ids] += 1
+    inclusion = counts / TRIALS
+    from code2vec_tpu.ops.sampled_softmax import _effective_num_tries
+    p = np.log((np.arange(V) + 2) / (np.arange(V) + 1)) / np.log(V + 1)
+    T = _effective_num_tries(S, V)
+    expected = -np.expm1(T * np.log1p(-p))
+    # the bias-correction model should track the sampler's true inclusion
+    # frequencies closely (it feeds log_expected_count)
+    np.testing.assert_allclose(inclusion[:10], expected[:10], rtol=0.06)
+    assert inclusion[0] > inclusion[10] > inclusion[50]
 
 
 def test_sampled_softmax_close_to_full_softmax_on_tiny_vocab():
