@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build the native path-context extractor (c2v_extract + libc2v.so).
+# Usage: ./build_extractor.sh [--sanitize]
+set -euo pipefail
+cd "$(dirname "$0")/code2vec_tpu/extractor"
+SAN=OFF
+if [[ "${1:-}" == "--sanitize" ]]; then SAN=ON; fi
+cmake -S . -B build -G Ninja -DC2V_SANITIZE=${SAN} >/dev/null
+cmake --build build
+echo "built: $(pwd)/build/c2v_extract and libc2v.so"

@@ -1,0 +1,83 @@
+"""ctypes bindings for the native extractor (libc2v.so).
+
+In-process extraction without subprocess overhead, for the data pipeline
+and tests. Falls back to the c2v_extract CLI if the shared library is
+missing. Build both with ./build_extractor.sh.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "build", "libc2v.so")
+_BIN_PATH = os.path.join(_DIR, "build", "c2v_extract")
+
+_lib = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and os.path.exists(_LIB_PATH):
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.c2v_extract_source.restype = ctypes.c_void_p
+        lib.c2v_extract_source.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                           ctypes.c_int, ctypes.c_int]
+        lib.c2v_free.argtypes = [ctypes.c_void_p]
+        lib.c2v_java_string_hash.restype = ctypes.c_int
+        lib.c2v_java_string_hash.argtypes = [ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return os.path.exists(_LIB_PATH) or os.path.exists(_BIN_PATH)
+
+
+def extract_source(source: str, max_path_length: int = 8,
+                   max_path_width: int = 2,
+                   max_leaves: int = 1000) -> List[str]:
+    """Java source text -> extractor output lines (`name tok,hash,tok ...`)."""
+    lib = _load()
+    if lib is not None:
+        ptr = lib.c2v_extract_source(source.encode("utf-8"),
+                                     max_path_length, max_path_width,
+                                     max_leaves)
+        if not ptr:
+            return []
+        try:
+            text = ctypes.string_at(ptr).decode("utf-8", errors="replace")
+        finally:
+            lib.c2v_free(ptr)
+        return [ln for ln in text.splitlines() if ln.strip()]
+    if os.path.exists(_BIN_PATH):
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".java",
+                                         delete=False) as f:
+            f.write(source)
+            tmp = f.name
+        try:
+            proc = subprocess.run(
+                [_BIN_PATH, "--file", tmp,
+                 "--max_path_length", str(max_path_length),
+                 "--max_path_width", str(max_path_width)],
+                capture_output=True, text=True, timeout=120)
+            return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        finally:
+            os.unlink(tmp)
+    raise FileNotFoundError(
+        "native extractor not built; run ./build_extractor.sh")
+
+
+def java_string_hash(s: str) -> int:
+    """Java String.hashCode (C implementation when built; the single
+    pure-python implementation lives in python_extractor)."""
+    lib = _load()
+    if lib is not None:
+        return lib.c2v_java_string_hash(s.encode("utf-8"))
+    from code2vec_tpu.extractor.python_extractor import (
+        java_string_hash as py_hash)
+    return py_hash(s)

@@ -245,8 +245,9 @@ class Code2VecModel(Code2VecModelBase):
 
     # ---- persistence ----
     def save(self, path: Optional[str] = None) -> None:
-        if jax.process_index() != 0:
-            return  # one writer per multi-host job; others would race
+        # NOTE: orbax save is a collective — every process must call it
+        # (orbax coordinates a single logical writer internally); skipping
+        # non-zero processes would deadlock cross-host saves.
         path = path or self.config.save_path
         assert path
         state = {"params": self.params, "opt_state": self.opt_state,

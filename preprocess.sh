@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Dataset build driver — reference-compatible (SURVEY.md §4.1):
+# extractor over train/val/test dirs (shuf on train), then preprocessing,
+# then binary shard int-ization for the TPU fast path.
+set -euo pipefail
+
+TRAIN_DIR=${TRAIN_DIR:-dataset/train}
+VAL_DIR=${VAL_DIR:-dataset/val}
+TEST_DIR=${TEST_DIR:-dataset/test}
+DATASET_NAME=${DATASET_NAME:-java-small}
+OUT_DIR=${OUT_DIR:-data/${DATASET_NAME}}
+MAX_CONTEXTS=${MAX_CONTEXTS:-200}
+WORD_VOCAB_SIZE=${WORD_VOCAB_SIZE:-1301136}
+PATH_VOCAB_SIZE=${PATH_VOCAB_SIZE:-911417}
+TARGET_VOCAB_SIZE=${TARGET_VOCAB_SIZE:-261245}
+NUM_THREADS=${NUM_THREADS:-64}
+MAX_PATH_LENGTH=${MAX_PATH_LENGTH:-8}
+MAX_PATH_WIDTH=${MAX_PATH_WIDTH:-2}
+EXTRACTOR=${EXTRACTOR:-code2vec_tpu/extractor/build/c2v_extract}
+
+if [[ ! -x "${EXTRACTOR}" ]]; then
+  echo "extractor not built; running ./build_extractor.sh" >&2
+  ./build_extractor.sh
+fi
+
+mkdir -p "${OUT_DIR}"
+
+extract() {
+  "${EXTRACTOR}" --dir "$1" --max_path_length "${MAX_PATH_LENGTH}" \
+    --max_path_width "${MAX_PATH_WIDTH}" --num_threads "${NUM_THREADS}"
+}
+
+echo "extracting ${TRAIN_DIR} ..." >&2
+extract "${TRAIN_DIR}" | shuf > "${OUT_DIR}/${DATASET_NAME}.train.raw.txt"
+echo "extracting ${VAL_DIR} ..." >&2
+extract "${VAL_DIR}" > "${OUT_DIR}/${DATASET_NAME}.val.raw.txt"
+echo "extracting ${TEST_DIR} ..." >&2
+extract "${TEST_DIR}" > "${OUT_DIR}/${DATASET_NAME}.test.raw.txt"
+
+python3 -m code2vec_tpu.data.preprocess \
+  --train_data "${OUT_DIR}/${DATASET_NAME}.train.raw.txt" \
+  --val_data "${OUT_DIR}/${DATASET_NAME}.val.raw.txt" \
+  --test_data "${OUT_DIR}/${DATASET_NAME}.test.raw.txt" \
+  --max_contexts "${MAX_CONTEXTS}" \
+  --word_vocab_size "${WORD_VOCAB_SIZE}" \
+  --path_vocab_size "${PATH_VOCAB_SIZE}" \
+  --target_vocab_size "${TARGET_VOCAB_SIZE}" \
+  --output_name "${OUT_DIR}/${DATASET_NAME}"
+
+python3 -m code2vec_tpu.data.binarize --data "${OUT_DIR}/${DATASET_NAME}" \
+  --max_contexts "${MAX_CONTEXTS}" \
+  --word_vocab_size "${WORD_VOCAB_SIZE}" \
+  --path_vocab_size "${PATH_VOCAB_SIZE}" \
+  --target_vocab_size "${TARGET_VOCAB_SIZE}"
+
+rm -f "${OUT_DIR}/${DATASET_NAME}".{train,val,test}.raw.txt
+echo "dataset ready under ${OUT_DIR}/" >&2

@@ -1,0 +1,34 @@
+package com.example;
+
+import java.util.List;
+import java.util.ArrayList;
+
+public class Example {
+    private int count;
+    private List<String> names = new ArrayList<>();
+
+    public int getCount() {
+        return count;
+    }
+
+    public void addName(String name) {
+        if (name != null && !name.isEmpty()) {
+            names.add(name.trim());
+            count++;
+        }
+    }
+
+    public String findLongest(List<String> items) {
+        String longest = "";
+        for (String item : items) {
+            if (item.length() > longest.length()) {
+                longest = item;
+            }
+        }
+        return longest;
+    }
+
+    public static int max(int a, int b) {
+        return a > b ? a : b;
+    }
+}

@@ -1,0 +1,55 @@
+package com.example;
+import java.util.*;
+import java.util.function.*;
+
+@SuppressWarnings("unchecked")
+public class Hard<T extends Comparable<T>> implements Iterable<T> {
+    private Map<String, List<Integer>> cache = new HashMap<>();
+
+    public <R> List<R> transform(List<T> input, Function<T, R> fn) {
+        List<R> result = new ArrayList<>(input.size());
+        for (int i = 0; i < input.size(); i++) {
+            result.add(fn.apply(input.get(i)));
+        }
+        return result;
+    }
+
+    public int sumEvens(int[] values) {
+        int total = 0;
+        for (int v : values) {
+            if ((v & 1) == 0) { total += v; }
+        }
+        return total;
+    }
+
+    public Optional<T> firstMatching(Collection<T> items, Predicate<T> p) {
+        return items.stream().filter(p).findFirst();
+    }
+
+    public void process() {
+        Runnable r = () -> System.out.println("hello" + 42);
+        Comparator<T> cmp = (a, b) -> a.compareTo(b);
+        try (AutoCloseable ac = open()) {
+            int x = (int) compute(3.14, 'c');
+            switch (x) {
+                case 1: doThing(); break;
+                case 2: case 3: other(); break;
+                default: fallback();
+            }
+        } catch (RuntimeException | Error e) {
+            throw new IllegalStateException("bad", e);
+        } finally {
+            cleanup();
+        }
+        new Thread(new Runnable() {
+            public void run() { loop(); }
+        }).start();
+        String s = x > 0 ? "pos" : "neg";
+        this.cache.put(s, Arrays.asList(1, 2, 3));
+        Supplier<List<T>> sup = ArrayList::new;
+        int[][] grid = new int[3][4];
+        var inferred = cache.keySet();
+    }
+
+    public Iterator<T> iterator() { return null; }
+}

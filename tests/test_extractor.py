@@ -1,0 +1,192 @@
+"""Native C++ extractor tests: golden-file comparison (SURVEY.md §5:
+"C++ extractor output vs. checked-in expected output"), hash parity,
+normalization parity with common.py, robustness on malformed input, and
+the Python-AST frontend."""
+
+import os
+import subprocess
+
+import pytest
+
+from code2vec_tpu.common import split_to_subtokens
+from code2vec_tpu.extractor import python_extractor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "code2vec_tpu", "extractor", "build",
+                   "c2v_extract")
+GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
+
+needs_binary = pytest.mark.skipif(
+    not os.path.exists(BIN),
+    reason="native extractor not built (run ./build_extractor.sh)")
+
+
+def run_extractor(*args) -> str:
+    proc = subprocess.run([BIN, *args], capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+@needs_binary
+@pytest.mark.parametrize("name", ["Example.java", "Hard.java"])
+def test_golden_files(name):
+    out = run_extractor("--file", os.path.join(GOLDEN_DIR, name))
+    with open(os.path.join(GOLDEN_DIR, name + ".expected")) as f:
+        expected = f.read()
+    assert out == expected
+
+
+@needs_binary
+def test_output_format_contract(tmp_path):
+    """SURVEY.md §3.2: `name ctx1 ... ctxN`, ctx = tok,pathHash,tok."""
+    src = tmp_path / "T.java"
+    src.write_text(
+        "class T { int addTwo(int x) { return x + 2; } }")
+    out = run_extractor("--file", str(src)).strip()
+    lines = out.splitlines()
+    assert len(lines) == 1
+    parts = lines[0].split(" ")
+    assert parts[0] == "add|two"
+    assert len(parts) > 1
+    for ctx in parts[1:]:
+        fields = ctx.split(",")
+        assert len(fields) == 3
+        int(fields[1])  # hashed path is an integer
+    # method's own name leaf appears as the special token
+    assert any("METHOD_NAME" in c for c in parts[1:])
+    # parameter and literal leaves appear normalized
+    joined = " ".join(parts[1:])
+    assert ",2" in joined or "2," in joined  # int literal kept
+    assert "x," in joined or ",x" in joined
+
+
+@needs_binary
+def test_java_string_hash_parity():
+    from code2vec_tpu.extractor import native
+    # pure-python fallback vs the C implementation
+    lib = native._load()
+    if lib is None:
+        pytest.skip("libc2v.so not built")
+    for s in ["", "a", "hello", "NameExpr^BlockStmt_ReturnStmt",
+              "x" * 100]:
+        c_val = lib.c2v_java_string_hash(s.encode())
+        py_val = python_extractor.java_string_hash(s)
+        assert c_val == py_val, s
+    # known Java values: "hello".hashCode() == 99162322
+    assert python_extractor.java_string_hash("hello") == 99162322
+    assert python_extractor.java_string_hash("polygenelubricants") == \
+        -2147483648  # the classic Integer.MIN_VALUE hash
+
+
+@needs_binary
+def test_normalization_parity_with_common(tmp_path):
+    """C++ subtoken splitting must match common.split_to_subtokens."""
+    src = tmp_path / "N.java"
+    src.write_text("class N { void fooBarBaz(int someHTMLValue2x) "
+                   "{ use(someHTMLValue2x); } }")
+    out = run_extractor("--file", str(src))
+    assert out.splitlines()[0].split(" ")[0] == \
+        "|".join(split_to_subtokens("fooBarBaz"))
+    assert "|".join(split_to_subtokens("someHTMLValue2x")) == \
+        "some|html|value|x"
+    assert "some|html|value|x," in out or ",some|html|value|x" in out
+
+
+@needs_binary
+def test_path_length_and_width_flags(tmp_path):
+    src = tmp_path / "L.java"
+    src.write_text("class L { int deep(int a) { if (a > 0) { "
+                   "while (a > 1) { a = a - 1; } } return a; } }")
+    wide = run_extractor("--file", str(src), "--max_path_length", "12",
+                         "--max_path_width", "3")
+    narrow = run_extractor("--file", str(src), "--max_path_length", "4",
+                           "--max_path_width", "1")
+    assert len(wide.split(" ")) > len(narrow.split(" "))
+
+
+@needs_binary
+def test_malformed_input_never_crashes(tmp_path):
+    cases = [
+        "",                               # empty
+        "not java at all @@@@ %%%",       # garbage
+        "class X {",                      # unbalanced
+        "class X { void f( { } }",        # broken params
+        "class X { void f() { if (a }",   # broken body
+        "class X { void g() { return 1; } void ok() { use(x); } }",
+    ]
+    for i, src in enumerate(cases):
+        p = tmp_path / f"M{i}.java"
+        p.write_text(src)
+        run_extractor("--file", str(p))  # asserts rc == 0
+
+
+@needs_binary
+def test_dir_mode_and_threads(tmp_path):
+    for i in range(8):
+        (tmp_path / f"F{i}.java").write_text(
+            f"class F{i} {{ int getNum{i}() {{ return {i}; }} }}")
+    out = run_extractor("--dir", str(tmp_path), "--num_threads", "4")
+    lines = out.strip().splitlines()
+    assert len(lines) == 8
+    names = sorted(ln.split(" ")[0] for ln in lines)
+    assert names[0].startswith("get|num")
+
+
+def test_ctypes_in_process_extraction():
+    from code2vec_tpu.extractor import native
+    if native._load() is None:
+        pytest.skip("libc2v.so not built")
+    lines = native.extract_source(
+        "class C { int plusOne(int v) { return v + 1; } }")
+    assert len(lines) == 1
+    assert lines[0].startswith("plus|one ")
+
+
+# ---- Python-AST frontend (python150k config) ----
+
+def test_python_extractor_basic():
+    lines = python_extractor.extract_source(
+        "def add_two(x):\n    return x + 2\n")
+    assert len(lines) == 1
+    parts = lines[0].split(" ")
+    assert parts[0] == "add|two"
+    for ctx in parts[1:]:
+        fields = ctx.split(",")
+        assert len(fields) == 3
+        int(fields[1])
+    assert any("METHOD_NAME" in c for c in parts[1:])
+
+
+def test_python_extractor_multiple_and_nested():
+    src = (
+        "def outer(a, b):\n"
+        "    def inner(c):\n"
+        "        return c * 2\n"
+        "    return inner(a) + b\n"
+        "\n"
+        "class K:\n"
+        "    def method_one(self, value):\n"
+        "        if value > 0:\n"
+        "            return self.cache[value]\n"
+        "        return None\n")
+    lines = python_extractor.extract_source(src)
+    names = [ln.split(" ")[0] for ln in lines]
+    assert "outer" in names and "inner" in names and "method|one" in names
+
+
+def test_python_extractor_syntax_error_returns_empty():
+    assert python_extractor.extract_source("def broken(:\n  pass") == []
+
+
+def test_python_extractor_respects_length_limit():
+    src = ("def f(a):\n"
+           "    if a:\n"
+           "        while a:\n"
+           "            a = a - 1\n"
+           "    return a\n")
+    wide = python_extractor.extract_source(src, max_path_length=14)
+    narrow = python_extractor.extract_source(src, max_path_length=4)
+    n_wide = len(wide[0].split(" ")) if wide else 0
+    n_narrow = len(narrow[0].split(" ")) if narrow else 0
+    assert n_wide > n_narrow
