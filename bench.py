@@ -55,7 +55,8 @@ def main() -> None:
     opt_state = optimizer.init(params)
     step = make_train_step(dims, optimizer, use_sampled_softmax=True,
                            num_sampled=NUM_SAMPLED,
-                           compute_dtype=jnp.bfloat16)
+                           compute_dtype=jnp.bfloat16,
+                           use_pallas=jax.default_backend() == "tpu")
 
     r = np.random.default_rng(0)
     def batch_for(i):

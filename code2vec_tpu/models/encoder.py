@@ -80,12 +80,14 @@ def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
            target_ids: jax.Array, mask: jax.Array, *,
            dropout_rng: Optional[jax.Array] = None,
            dropout_keep_rate: float = 1.0,
-           compute_dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+           compute_dtype=jnp.float32,
+           use_pallas: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Forward to the code vector.
 
     Args: [B, C] int32 ids for source token / path / target token, [B, C]
     f32 mask. Returns (code_vectors [B, D] in compute dtype,
-    attention [B, C] f32).
+    attention [B, C] f32). use_pallas selects the fused Pallas pooling
+    kernel (ops/pallas_attention.py).
     """
     src = jnp.take(params["token_emb"], source_ids, axis=0)
     pth = jnp.take(params["path_emb"], path_ids, axis=0)
@@ -97,15 +99,21 @@ def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
                                     contexts.shape)
         contexts = jnp.where(keep, contexts / dropout_keep_rate, 0.0)
 
+    if use_pallas:
+        from code2vec_tpu.ops.pallas_attention import attention_pool_fused
+        code, attn = attention_pool_fused(
+            contexts, params["transform"], params["attention"], mask)
+        return code.astype(compute_dtype), attn
     return attention_pool(contexts, params["transform"],
                           params["attention"], mask)
 
 
-def full_logits(params: Params, code_vectors: jax.Array,
-                true_target_vocab_size: Optional[int] = None) -> jax.Array:
-    """[B, V] logits against the (possibly row-padded) target table.
+def logits_vs_table(table: jax.Array, code_vectors: jax.Array,
+                    true_target_vocab_size: Optional[int] = None
+                    ) -> jax.Array:
+    """[B, V] logits against a (possibly row-padded) target table.
     Padding rows are masked to -inf so they never win top-k."""
-    table = params["target_emb"].astype(code_vectors.dtype)
+    table = table.astype(code_vectors.dtype)
     logits = (code_vectors @ table.T).astype(jnp.float32)
     if (true_target_vocab_size is not None
             and true_target_vocab_size < table.shape[0]):
@@ -113,3 +121,9 @@ def full_logits(params: Params, code_vectors: jax.Array,
         logits = jnp.where(col[None, :] < true_target_vocab_size,
                            logits, -1e9)
     return logits
+
+
+def full_logits(params: Params, code_vectors: jax.Array,
+                true_target_vocab_size: Optional[int] = None) -> jax.Array:
+    return logits_vs_table(params["target_emb"], code_vectors,
+                           true_target_vocab_size)

@@ -60,6 +60,8 @@ class Code2VecModel(Code2VecModelBase):
                 "use_sampled_softmax", cfg.USE_SAMPLED_SOFTMAX)
             cfg.NUM_SAMPLED_CLASSES = manifest.get(
                 "num_sampled", cfg.NUM_SAMPLED_CLASSES)
+            cfg.SPARSE_EMBEDDING_UPDATES = manifest.get(
+                "sparse_embedding_updates", cfg.SPARSE_EMBEDDING_UPDATES)
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -77,7 +79,13 @@ class Code2VecModel(Code2VecModelBase):
         self.step_num = 0
         self.rng, init_rng = jax.random.split(self.rng)
         params = init_params(init_rng, self.dims)
-        opt_state = self.optimizer.init(params)
+        if cfg.SPARSE_EMBEDDING_UPDATES:
+            from code2vec_tpu.training.sparse_steps import (
+                init_sparse_opt_state)
+            opt_state = init_sparse_opt_state(params, self.optimizer,
+                                              cfg.USE_SAMPLED_SOFTMAX)
+        else:
+            opt_state = self.optimizer.init(params)
         if cfg.is_loading:
             if manifest.get("released"):
                 loaded = ckpt.load_checkpoint(cfg.load_path,
@@ -98,16 +106,28 @@ class Code2VecModel(Code2VecModelBase):
         self.params, self.opt_state = params, opt_state
 
         # ---- jitted steps ----
-        self._train_step = make_train_step(
-            self.dims, self.optimizer,
-            use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
-            num_sampled=cfg.NUM_SAMPLED_CLASSES,
-            compute_dtype=self.compute_dtype)
+        if cfg.SPARSE_EMBEDDING_UPDATES:
+            from code2vec_tpu.training.sparse_steps import (
+                make_sparse_train_step)
+            self._train_step = make_sparse_train_step(
+                self.dims, learning_rate=cfg.LEARNING_RATE,
+                use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
+                num_sampled=cfg.NUM_SAMPLED_CLASSES,
+                compute_dtype=self.compute_dtype)
+        else:
+            self._train_step = make_train_step(
+                self.dims, self.optimizer,
+                use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
+                num_sampled=cfg.NUM_SAMPLED_CLASSES,
+                compute_dtype=self.compute_dtype,
+                use_pallas=cfg.USE_PALLAS)
         top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
         self._eval_step = make_eval_step(self.dims, top_k=top_k,
-                                         compute_dtype=self.compute_dtype)
+                                         compute_dtype=self.compute_dtype,
+                                         use_pallas=cfg.USE_PALLAS)
         self._predict_step = make_predict_step(
-            self.dims, top_k=top_k, compute_dtype=self.compute_dtype)
+            self.dims, top_k=top_k, compute_dtype=self.compute_dtype,
+            use_pallas=cfg.USE_PALLAS)
 
     # ---- vocabs: dataset dict when training, checkpoint sidecar when
     # loading (SURVEY.md §3.2 "Model checkpoint") ----
@@ -253,7 +273,9 @@ class Code2VecModel(Code2VecModelBase):
         state = {"params": self.params, "opt_state": self.opt_state,
                  "step": self.step_num}
         extra = {"use_sampled_softmax": self.config.USE_SAMPLED_SOFTMAX,
-                 "num_sampled": self.config.NUM_SAMPLED_CLASSES}
+                 "num_sampled": self.config.NUM_SAMPLED_CLASSES,
+                 "sparse_embedding_updates":
+                     self.config.SPARSE_EMBEDDING_UPDATES}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

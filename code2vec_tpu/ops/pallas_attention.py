@@ -1,0 +1,119 @@
+"""Fused masked attention-pool as a Pallas TPU kernel.
+
+One kernel fuses the whole pooling block (SURVEY.md §3.1 names this the
+Pallas candidate): tanh(ctx @ TRANSFORM) -> masked softmax over contexts
+-> attention-weighted sum, per batch block, with the [BB*C, D] matmul on
+the MXU and softmax/weighted-sum on the VPU — no [B, C, D] `transformed`
+intermediate ever hits HBM.
+
+Measured reality on one v5e chip (java-large shapes): the XLA path is
+embedding-gather-bound, and XLA already fuses this block competitively,
+so the kernel is opt-in (`attention_pool_pallas` / Config.USE_PALLAS) and
+exists for (a) configs with much larger C/D where the fused intermediate
+matters and (b) the component inventory. Two sibling experiments are
+documented here as negative results: a per-row DMA gather kernel (23 ms
+vs XLA's 15.5 ms for 409k rows — scalar-core DMA issue rate bound) and a
+fused dense-Adam kernel (17.9 ms vs optax's 15.8 ms — both at the chip's
+~280 GB/s effective streaming bandwidth).
+
+CPU tests run the same kernel with interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BB = 8  # batch rows per program
+
+
+def _attention_kernel(ctx_ref, tr_ref, at_ref, mask_ref, code_ref,
+                      attn_ref):
+    bb, C, D = ctx_ref.shape
+    ctx = ctx_ref[:].reshape(bb * C, D)
+    transformed = jnp.tanh(
+        jnp.dot(ctx, tr_ref[:], preferred_element_type=jnp.float32))
+    scores = jnp.dot(transformed, at_ref[:].reshape(D, 1),
+                     preferred_element_type=jnp.float32)  # [bb*C, 1]
+    scores = scores.reshape(bb, C)
+    mask = mask_ref[:]
+    scores = jnp.where(mask > 0, scores, -1e9)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    attn = e / denom
+    any_valid = jnp.sum(mask, axis=-1, keepdims=True) > 0
+    attn = jnp.where(any_valid, attn, 0.0)
+    attn_ref[:] = attn
+    weighted = transformed.reshape(bb, C, D) * attn[:, :, None]
+    code_ref[:] = jnp.sum(weighted, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def attention_pool_pallas(contexts: jax.Array, transform: jax.Array,
+                          attention: jax.Array, mask: jax.Array,
+                          interpret: bool | None = None
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in for ops.attention.attention_pool (same signature/semantics;
+    f32 outputs). The batch is padded to a multiple of 8 internally;
+    interpret=None auto-selects interpreter mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, C, D = contexts.shape
+    pad = (-B) % _BB
+    if pad:
+        contexts = jnp.concatenate(
+            [contexts, jnp.zeros((pad, C, D), contexts.dtype)], axis=0)
+        mask = jnp.concatenate(
+            [mask, jnp.zeros((pad, C), mask.dtype)], axis=0)
+    BP = B + pad
+    f32 = jnp.float32
+    code, attn = pl.pallas_call(
+        _attention_kernel,
+        grid=(BP // _BB,),
+        in_specs=[
+            pl.BlockSpec((_BB, C, D), lambda i: (i, 0, 0)),
+            pl.BlockSpec((D, D), lambda i: (0, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((_BB, C), lambda i: (i, 0)),
+        ],
+        out_specs=(pl.BlockSpec((_BB, D), lambda i: (i, 0)),
+                   pl.BlockSpec((_BB, C), lambda i: (i, 0))),
+        out_shape=(jax.ShapeDtypeStruct((BP, D), f32),
+                   jax.ShapeDtypeStruct((BP, C), f32)),
+        interpret=interpret,
+    )(contexts.astype(f32), transform.astype(f32), attention.astype(f32),
+      mask.astype(f32))
+    return code[:B], attn[:B]
+
+
+# Differentiable wrapper: Pallas forward, XLA-recompute backward (the
+# pooled intermediate is rematerialized — same trade jax.checkpoint
+# makes; avoids hand-writing a backward kernel).
+@jax.custom_vjp
+def attention_pool_fused(contexts, transform, attention, mask):
+    return attention_pool_pallas(contexts, transform, attention, mask)
+
+
+def _fused_fwd(contexts, transform, attention, mask):
+    out = attention_pool_pallas(contexts, transform, attention, mask)
+    return out, (contexts, transform, attention, mask)
+
+
+def _fused_bwd(residuals, cotangents):
+    from code2vec_tpu.ops.attention import attention_pool
+    contexts, transform, attention, mask = residuals
+
+    def ref(c, t, a):
+        code, attn = attention_pool(c, t, a, mask)
+        return code.astype(jnp.float32), attn
+    _, vjp = jax.vjp(ref, contexts, transform, attention)
+    d_c, d_t, d_a = vjp(cotangents)
+    return d_c, d_t, d_a, jnp.zeros_like(mask)
+
+
+attention_pool_fused.defvjp(_fused_fwd, _fused_bwd)

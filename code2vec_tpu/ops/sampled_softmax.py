@@ -94,6 +94,36 @@ def _log_expected_count(ids: jax.Array, num_sampled: int,
     return jnp.log(-jnp.expm1(T * jnp.log1p(-p)))
 
 
+def sampled_softmax_from_gathered(
+        code_vectors: jax.Array, true_w: jax.Array, samp_w: jax.Array,
+        true_corr: jax.Array, samp_corr: jax.Array,
+        accidental: jax.Array,
+        example_weights: jax.Array | None = None) -> jax.Array:
+    """The shared logit/correction/accidental-hit core, taking
+    PRE-GATHERED target rows — called both by sampled_softmax_loss and by
+    the sparse-embedding train step (which differentiates w.r.t. the
+    gathered rows themselves).
+
+    Args: code [B, D]; true_w [B, D]; samp_w [S, D]; log-expected-count
+    corrections true_corr [B] / samp_corr [S]; accidental [B, S] mask of
+    sampled==label collisions; optional [B] example weights.
+    Returns the scalar mean loss.
+    """
+    dtype = code_vectors.dtype
+    true_logits = jnp.sum(code_vectors * true_w.astype(dtype),
+                          axis=-1).astype(jnp.float32) - true_corr
+    sampled_logits = (code_vectors @ samp_w.astype(dtype).T).astype(
+        jnp.float32) - samp_corr[None, :]
+    sampled_logits = jnp.where(accidental, -1e9, sampled_logits)
+    logits = jnp.concatenate([true_logits[:, None], sampled_logits],
+                             axis=1)
+    per_example = -jax.nn.log_softmax(logits, axis=-1)[:, 0]
+    if example_weights is not None:
+        denom = jnp.maximum(jnp.sum(example_weights), 1.0)
+        return jnp.sum(per_example * example_weights) / denom
+    return jnp.mean(per_example)
+
+
 def sampled_softmax_loss(
         target_table: jax.Array, code_vectors: jax.Array,
         labels: jax.Array, rng: jax.Array, num_sampled: int,
@@ -119,25 +149,12 @@ def sampled_softmax_loss(
     # S > V degenerates to the exhaustive candidate set (full softmax)
     num_sampled = min(num_sampled, vocab_size)
     sampled = log_uniform_sample(rng, num_sampled, vocab_size)  # [S]
-
-    dtype = code_vectors.dtype
-    true_w = target_table[labels].astype(dtype)          # [B, D]
-    sampled_w = target_table[sampled].astype(dtype)      # [S, D]
-
-    true_logits = jnp.sum(code_vectors * true_w, axis=-1).astype(jnp.float32)
-    sampled_logits = (code_vectors @ sampled_w.T).astype(jnp.float32)
-
-    true_logits = true_logits - _log_expected_count(
-        labels, num_sampled, vocab_size)
-    sampled_logits = sampled_logits - _log_expected_count(
-        sampled, num_sampled, vocab_size)[None, :]
-
-    accidental = sampled[None, :] == labels[:, None]     # [B, S]
-    sampled_logits = jnp.where(accidental, -1e9, sampled_logits)
-
-    logits = jnp.concatenate([true_logits[:, None], sampled_logits], axis=1)
-    per_example = -jax.nn.log_softmax(logits, axis=-1)[:, 0]
-    if example_weights is not None:
-        denom = jnp.maximum(jnp.sum(example_weights), 1.0)
-        return jnp.sum(per_example * example_weights) / denom, sampled
-    return jnp.mean(per_example), sampled
+    loss = sampled_softmax_from_gathered(
+        code_vectors,
+        true_w=target_table[labels],
+        samp_w=target_table[sampled],
+        true_corr=_log_expected_count(labels, num_sampled, vocab_size),
+        samp_corr=_log_expected_count(sampled, num_sampled, vocab_size),
+        accidental=sampled[None, :] == labels[:, None],
+        example_weights=example_weights)
+    return loss, sampled

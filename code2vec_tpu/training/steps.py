@@ -35,7 +35,8 @@ def _weighted_mean(values: jax.Array, weights: jax.Array) -> jax.Array:
 def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
                     *, use_sampled_softmax: bool = False,
                     num_sampled: int = 4096,
-                    compute_dtype=jnp.float32) -> Callable:
+                    compute_dtype=jnp.float32,
+                    use_pallas: bool = False) -> Callable:
     """Returns jitted `step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)` where batch is a 6-tuple of arrays
     (labels [B], src/path/dst ids [B, C], mask [B, C],
@@ -46,7 +47,7 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
         code, _attn = encode(
             params, src, pth, dst, mask, dropout_rng=drop_rng,
             dropout_keep_rate=dims.dropout_keep_rate,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, use_pallas=use_pallas)
         if use_sampled_softmax:
             loss, _ = sampled_softmax_loss(
                 params["target_emb"], code, labels, sample_rng,
@@ -72,7 +73,8 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
 
 
 def make_eval_step(dims: ModelDims, *, top_k: int = 10,
-                   compute_dtype=jnp.float32) -> Callable:
+                   compute_dtype=jnp.float32,
+                   use_pallas: bool = False) -> Callable:
     """Returns jitted `step(params, batch) -> (loss_sum, topk_ids,
     topk_probs)`; no dropout (SURVEY.md §4.3)."""
 
@@ -80,7 +82,8 @@ def make_eval_step(dims: ModelDims, *, top_k: int = 10,
     def step(params, batch):
         labels, src, pth, dst, mask, weights = batch
         code, _attn = encode(params, src, pth, dst, mask,
-                             compute_dtype=compute_dtype)
+                             compute_dtype=compute_dtype,
+                             use_pallas=use_pallas)
         logits = full_logits(params, code, dims.target_vocab_size)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
         loss_sum = jnp.sum(ce * weights)
@@ -92,7 +95,8 @@ def make_eval_step(dims: ModelDims, *, top_k: int = 10,
 
 
 def make_encode_step(dims: ModelDims, *,
-                     compute_dtype=jnp.float32) -> Callable:
+                     compute_dtype=jnp.float32,
+                     use_pallas: bool = False) -> Callable:
     """Returns jitted `step(params, batch) -> code_vectors [B, D] f32` —
     encoder only, no [B, V] logits matmul. Used by --export_code_vectors
     over a whole test split, where top-k/softmax would be wasted FLOPs."""
@@ -101,14 +105,16 @@ def make_encode_step(dims: ModelDims, *,
     def step(params, batch):
         _labels, src, pth, dst, mask, _weights = batch
         code, _attn = encode(params, src, pth, dst, mask,
-                             compute_dtype=compute_dtype)
+                             compute_dtype=compute_dtype,
+                             use_pallas=use_pallas)
         return code.astype(jnp.float32)
 
     return step
 
 
 def make_predict_step(dims: ModelDims, *, top_k: int = 10,
-                      compute_dtype=jnp.float32) -> Callable:
+                      compute_dtype=jnp.float32,
+                      use_pallas: bool = False) -> Callable:
     """Returns jitted `step(params, batch) -> (topk_ids, topk_probs,
     attention, code_vectors)` — the predict graph additionally surfaces
     per-context attention and the code vector (SURVEY.md §4.4,
@@ -118,7 +124,8 @@ def make_predict_step(dims: ModelDims, *, top_k: int = 10,
     def step(params, batch):
         _labels, src, pth, dst, mask, _weights = batch
         code, attn = encode(params, src, pth, dst, mask,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            use_pallas=use_pallas)
         logits = full_logits(params, code, dims.target_vocab_size)
         probs = jax.nn.softmax(logits, axis=-1)
         topk_probs, topk_ids = jax.lax.top_k(probs, top_k)

@@ -1,0 +1,58 @@
+"""Model-level test of the sparse-embedding-updates config: training
+converges and checkpoint resume round-trips the sparse opt state."""
+
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models.jax_model import Code2VecModel
+from tests.helpers import build_tiny_dataset
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data_sparse")
+    return build_tiny_dataset(str(d), n_train=256, n_val=32, n_test=64,
+                              max_contexts=16)
+
+
+def _cfg(prefix, **kw):
+    cfg = Config(MAX_CONTEXTS=16, MAX_TOKEN_VOCAB_SIZE=1000,
+                 MAX_PATH_VOCAB_SIZE=1000, MAX_TARGET_VOCAB_SIZE=1000,
+                 DEFAULT_EMBEDDINGS_SIZE=16, TRAIN_BATCH_SIZE=32,
+                 TEST_BATCH_SIZE=32, NUM_TRAIN_EPOCHS=6,
+                 SAVE_EVERY_EPOCHS=100, NUM_BATCHES_TO_LOG_PROGRESS=1000,
+                 LEARNING_RATE=0.05, USE_BF16=False,
+                 SPARSE_EMBEDDING_UPDATES=True)
+    cfg.train_data_path = prefix
+    cfg.test_data_path = prefix + ".test.c2v"
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_sparse_training_converges_and_resumes(dataset, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    cfg = _cfg(dataset, save_path=ckpt)
+    model = Code2VecModel(cfg)
+    before = model.evaluate()
+    model.train()
+    after = model.evaluate()
+    assert after.loss < before.loss
+    assert after.subtoken_f1 > 0.5
+    model.save(ckpt)
+
+    cfg2 = _cfg(dataset)
+    cfg2.load_path = ckpt
+    model2 = Code2VecModel(cfg2)
+    assert cfg2.SPARSE_EMBEDDING_UPDATES  # restored from manifest
+    loaded = model2.evaluate()
+    assert loaded.topk_acc == pytest.approx(after.topk_acc)
+
+
+def test_sparse_with_sampled_softmax(dataset):
+    cfg = _cfg(dataset, USE_SAMPLED_SOFTMAX=True, NUM_SAMPLED_CLASSES=6)
+    model = Code2VecModel(cfg)
+    before = model.evaluate()
+    model.train()
+    after = model.evaluate()
+    assert after.loss < before.loss

@@ -1,0 +1,141 @@
+"""Sparse-row Adam: dedupe correctness vs numpy, and exact agreement with
+the dense-Adam step when every row is touched (lazy == dense in that
+case, including the first step from zero moments)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from code2vec_tpu.models.encoder import ModelDims, init_params
+from code2vec_tpu.training.sparse_adam import dedupe_rows, row_adam_update
+from code2vec_tpu.training.sparse_steps import (init_sparse_opt_state,
+                                                make_sparse_train_step)
+from code2vec_tpu.training.steps import make_train_step
+
+DIMS = ModelDims(token_vocab_size=12, path_vocab_size=10,
+                 target_vocab_size=8, embeddings_size=4, max_contexts=5,
+                 dropout_keep_rate=1.0)
+
+
+def test_dedupe_rows_sums_duplicates():
+    ids = jnp.asarray([3, 1, 3, 7, 1, 3], dtype=jnp.int32)
+    grads = jnp.arange(6 * 2, dtype=jnp.float32).reshape(6, 2)
+    uids, g = dedupe_rows(ids, grads, vocab_size=10)
+    uids, g = np.asarray(uids), np.asarray(g)
+    expected = {1: grads[1] + grads[4], 3: grads[0] + grads[2] + grads[5],
+                7: grads[3]}
+    seen = {}
+    for i, uid in enumerate(uids):
+        if uid < 10 and np.any(g[i] != 0):
+            assert uid not in seen
+            seen[int(uid)] = g[i]
+    assert set(seen) == set(expected)
+    for k in expected:
+        np.testing.assert_allclose(seen[k], np.asarray(expected[k]))
+
+
+def test_row_adam_matches_dense_adam_when_all_rows_touched():
+    rng = np.random.default_rng(0)
+    V, E = 6, 3
+    table = jnp.asarray(rng.normal(size=(V, E)).astype(np.float32))
+    grad_dense = rng.normal(size=(V, E)).astype(np.float32)
+
+    # dense optax adam, one step
+    opt = optax.adam(0.01)
+    state = opt.init(table)
+    upd, _ = opt.update(jnp.asarray(grad_dense), state, table)
+    dense_out = optax.apply_updates(table, upd)
+
+    # sparse: every row appears exactly once
+    from code2vec_tpu.training.sparse_adam import init_row_adam
+    rstate = init_row_adam(table)
+    sparse_out, _ = row_adam_update(
+        table, rstate, jnp.arange(V, dtype=jnp.int32),
+        jnp.asarray(grad_dense), count=jnp.asarray(1, jnp.int32), lr=0.01)
+    np.testing.assert_allclose(np.asarray(sparse_out),
+                               np.asarray(dense_out), atol=1e-6)
+
+
+def _batch(seed, b=8):
+    r = np.random.default_rng(seed)
+    C = DIMS.max_contexts
+    return (r.integers(0, DIMS.target_vocab_size, (b,)).astype(np.int32),
+            r.integers(0, DIMS.token_vocab_size, (b, C)).astype(np.int32),
+            r.integers(0, DIMS.path_vocab_size, (b, C)).astype(np.int32),
+            r.integers(0, DIMS.token_vocab_size, (b, C)).astype(np.int32),
+            np.ones((b, C), np.float32), np.ones((b,), np.float32))
+
+
+def test_sparse_step_first_step_matches_dense_step():
+    """From zero moments, untouched rows get zero updates under dense
+    Adam too, so step 1 must agree exactly (full-softmax config)."""
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    lr = 0.02
+    batch = tuple(jnp.asarray(a) for a in _batch(1))
+    rng = jax.random.PRNGKey(3)
+
+    dense_step = make_train_step(DIMS, optax.adam(lr))
+    p1, _, loss1 = dense_step(jax.tree_util.tree_map(jnp.copy, params),
+                              optax.adam(lr).init(params), batch, rng)
+
+    sp_step = make_sparse_train_step(DIMS, learning_rate=lr)
+    opt_state = init_sparse_opt_state(params, optax.adam(lr),
+                                      use_sampled_softmax=False)
+    p2, _, loss2 = sp_step(jax.tree_util.tree_map(jnp.copy, params),
+                           opt_state, batch, rng)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    for k in p1:
+        # scatter-add vs segment-sum accumulate duplicates in different
+        # orders; Adam's m/(sqrt(v)+eps) amplifies those ulps for rows
+        # with tiny gradients, so agreement is ~1e-4, not exact
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   atol=2e-4, err_msg=k)
+
+
+def test_sparse_step_sampled_softmax_trains():
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    step = make_sparse_train_step(DIMS, learning_rate=0.05,
+                                  use_sampled_softmax=True, num_sampled=4)
+    opt_state = init_sparse_opt_state(params, optax.adam(0.05),
+                                      use_sampled_softmax=True)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    batch = tuple(jnp.asarray(a) for a in _batch(2))
+    for i in range(30):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state, batch, k)
+        losses.append(float(loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    for k_, v in params.items():
+        assert np.all(np.isfinite(np.asarray(v))), k_
+
+
+def test_sparse_step_on_mesh_matches_single_device():
+    from code2vec_tpu.parallel.mesh import make_mesh
+    from code2vec_tpu.parallel.sharding import shard_batch, shard_params
+    dims = ModelDims(token_vocab_size=12, path_vocab_size=10,
+                     target_vocab_size=8, embeddings_size=4,
+                     max_contexts=5, dropout_keep_rate=1.0,
+                     vocab_pad_multiple=2)
+    params = init_params(jax.random.PRNGKey(0), dims)
+    batch = tuple(jnp.asarray(a) for a in _batch(3, b=16))
+    rng = jax.random.PRNGKey(1)
+
+    step = make_sparse_train_step(dims, learning_rate=0.01)
+    o1 = init_sparse_opt_state(params, optax.adam(0.01), False)
+    p1, _, loss1 = step(jax.tree_util.tree_map(jnp.copy, params), o1,
+                        batch, rng)
+
+    mesh = make_mesh(0, 2)
+    sp = shard_params(mesh, params)
+    o2 = init_sparse_opt_state(sp, optax.adam(0.01), False)
+    sb = shard_batch(mesh, batch)
+    step2 = make_sparse_train_step(dims, learning_rate=0.01)
+    p2, _, loss2 = step2(sp, o2, sb, rng)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   atol=1e-5, err_msg=k)
