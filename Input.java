@@ -1,0 +1,30 @@
+class Demo {
+    int[] sortedItems;
+
+    boolean contains(int target) {
+        int lo = 0;
+        int hi = sortedItems.length - 1;
+        while (lo <= hi) {
+            int mid = (lo + hi) / 2;
+            if (sortedItems[mid] == target) {
+                return true;
+            }
+            if (sortedItems[mid] < target) {
+                lo = mid + 1;
+            } else {
+                hi = mid - 1;
+            }
+        }
+        return false;
+    }
+
+    int maxValue(int[] values) {
+        int best = values[0];
+        for (int i = 1; i < values.length; i++) {
+            if (values[i] > best) {
+                best = values[i];
+            }
+        }
+        return best;
+    }
+}

@@ -41,7 +41,7 @@ class Config:
     MAX_TO_KEEP: int = 10
     NUM_BATCHES_TO_LOG_PROGRESS: int = 100
     TOP_K_WORDS_CONSIDERED_DURING_PREDICTION: int = 10
-    LEARNING_RATE: float = 0.01  # Adam; reference uses TF Adam defaults-ish
+    LEARNING_RATE: float = 0.001  # tf.train.AdamOptimizer default (parity)
     SEED: int = 239
 
     # ---- softmax strategy (TPU addition; SURVEY.md §3.3 requires sampled

@@ -19,9 +19,28 @@ _BIN_PATH = os.path.join(_DIR, "build", "c2v_extract")
 _lib = None
 
 
+def _stale_warning() -> None:
+    """Warn when a source file is newer than the built library, so a stale
+    build can't silently serve old extraction behavior."""
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        for name in os.listdir(_DIR):
+            if name.endswith((".cc", ".h")):
+                if os.path.getmtime(os.path.join(_DIR, name)) > lib_mtime:
+                    import warnings
+                    warnings.warn(
+                        f"native extractor source {name} is newer than "
+                        f"{_LIB_PATH}; re-run ./build_extractor.sh",
+                        RuntimeWarning, stacklevel=3)
+                    return
+    except OSError:
+        pass
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is None and os.path.exists(_LIB_PATH):
+        _stale_warning()
         lib = ctypes.CDLL(_LIB_PATH)
         lib.c2v_extract_source.restype = ctypes.c_void_p
         lib.c2v_extract_source.argtypes = [ctypes.c_char_p, ctypes.c_int,

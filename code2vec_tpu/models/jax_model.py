@@ -91,7 +91,10 @@ class Code2VecModel(Code2VecModelBase):
                 loaded = ckpt.load_checkpoint(cfg.load_path,
                                               {"params": params})
                 params = loaded["params"]
-                opt_state = self.optimizer.init(params)
+                # A released checkpoint carries no optimizer state; keep
+                # the freshly-initialized opt_state built above — it
+                # already matches the train step's expected structure
+                # (sparse dict vs optax Adam, per the manifest override).
                 self.step_num = int(manifest.get("step", 0))
             else:
                 full = ckpt.load_checkpoint(

@@ -6,12 +6,13 @@ HBM per step (measured 45 ms/step on one v5e chip). Only a few hundred
 thousand rows are touched per batch, so moments and parameters are
 updated for TOUCHED ROWS ONLY:
 
-  sort ids -> segment-sum duplicate cotangents -> gather m/v rows ->
-  per-row Adam -> scatter param/m/v rows back (idempotent `set`s; unused
-  segment slots get an out-of-range id and `mode='drop'`).
+  scatter-ADD cotangents into a dense [V, E] gradient-sum buffer (the
+  VJP of a gather) -> gather the summed gradients, m/v, and params at
+  the touched ids -> per-row Adam -> scatter-SET rows back (duplicates
+  of a row write identical values, so the sets are idempotent).
 
 Everything is static-shaped (N = number of gathered rows per step), so
-the step jits once and XLA maps sort/segment_sum/scatter onto the TPU.
+the step jits once and XLA maps the gather/scatter onto the TPU.
 
 Semantics note (documented deviation): TF1's AdamOptimizer._apply_sparse
 decays m/v over ALL rows each step (which is exactly the dense traffic we
@@ -36,33 +37,6 @@ class RowAdamState(NamedTuple):
 
 def init_row_adam(table: jax.Array) -> RowAdamState:
     return RowAdamState(m=jnp.zeros_like(table), v=jnp.zeros_like(table))
-
-
-def dedupe_rows(ids: jax.Array, grads: jax.Array, vocab_size: int):
-    """Combine duplicate row-gradients.
-
-    Args:
-      ids:   [N] int32 row ids (with duplicates).
-      grads: [N, E] cotangents for each gathered row.
-      vocab_size: rows >= vocab_size never occur in `ids`.
-
-    Returns (uids [N], g_sum [N, E]): position s holds segment s's row id
-    and summed gradient; unused tail positions hold id == vocab_size
-    (out-of-range -> dropped by scatters with mode='drop').
-    """
-    n = ids.shape[0]
-    sorted_ids, perm = jax.lax.sort_key_val(ids, jnp.arange(n,
-                                                            dtype=jnp.int32))
-    g_sorted = jnp.take(grads, perm, axis=0)
-    boundary = jnp.concatenate(
-        [jnp.ones((1,), jnp.int32),
-         (sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32)])
-    seg = jnp.cumsum(boundary) - 1  # [N] segment index per position
-    g_sum = jax.ops.segment_sum(g_sorted, seg, num_segments=n)
-    uids = jnp.full((n,), vocab_size, dtype=jnp.int32)
-    # all positions of a segment write the same id -> deterministic
-    uids = uids.at[seg].set(sorted_ids)
-    return uids, g_sum
 
 
 def row_adam_update(table: jax.Array, state: RowAdamState,

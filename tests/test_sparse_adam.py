@@ -1,6 +1,6 @@
-"""Sparse-row Adam: dedupe correctness vs numpy, and exact agreement with
-the dense-Adam step when every row is touched (lazy == dense in that
-case, including the first step from zero moments)."""
+"""Sparse-row Adam: duplicate-row accumulation correctness, and exact
+agreement with the dense-Adam step when every row is touched (lazy ==
+dense in that case, including the first step from zero moments)."""
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +8,7 @@ import numpy as np
 import optax
 
 from code2vec_tpu.models.encoder import ModelDims, init_params
-from code2vec_tpu.training.sparse_adam import dedupe_rows, row_adam_update
+from code2vec_tpu.training.sparse_adam import row_adam_update
 from code2vec_tpu.training.sparse_steps import (init_sparse_opt_state,
                                                 make_sparse_train_step)
 from code2vec_tpu.training.steps import make_train_step
@@ -18,21 +18,31 @@ DIMS = ModelDims(token_vocab_size=12, path_vocab_size=10,
                  dropout_keep_rate=1.0)
 
 
-def test_dedupe_rows_sums_duplicates():
+def test_row_adam_duplicate_ids_accumulate():
+    """Duplicate ids must contribute summed gradients, and each touched
+    row must receive exactly one Adam update for that sum."""
+    V, E = 10, 2
+    table = jnp.zeros((V, E), jnp.float32)
+    from code2vec_tpu.training.sparse_adam import init_row_adam
+    state = init_row_adam(table)
     ids = jnp.asarray([3, 1, 3, 7, 1, 3], dtype=jnp.int32)
     grads = jnp.arange(6 * 2, dtype=jnp.float32).reshape(6, 2)
-    uids, g = dedupe_rows(ids, grads, vocab_size=10)
-    uids, g = np.asarray(uids), np.asarray(g)
-    expected = {1: grads[1] + grads[4], 3: grads[0] + grads[2] + grads[5],
-                7: grads[3]}
-    seen = {}
-    for i, uid in enumerate(uids):
-        if uid < 10 and np.any(g[i] != 0):
-            assert uid not in seen
-            seen[int(uid)] = g[i]
-    assert set(seen) == set(expected)
-    for k in expected:
-        np.testing.assert_allclose(seen[k], np.asarray(expected[k]))
+    out, _ = row_adam_update(table, state, ids, grads,
+                             count=jnp.asarray(1, jnp.int32), lr=0.01)
+    out = np.asarray(out)
+    expected_sums = {1: grads[1] + grads[4],
+                     3: grads[0] + grads[2] + grads[5], 7: grads[3]}
+    for row in range(V):
+        if row not in expected_sums:
+            np.testing.assert_allclose(out[row], 0.0)
+            continue
+        g = np.asarray(expected_sums[row])
+        # one Adam step from zero moments with summed gradient g
+        m = 0.1 * g
+        v = 0.001 * np.square(g)
+        lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        ref = -lr_t * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(out[row], ref, rtol=1e-5)
 
 
 def test_row_adam_matches_dense_adam_when_all_rows_touched():
