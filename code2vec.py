@@ -13,8 +13,7 @@ Dispatch order mirrors the reference `code2vec.py.__main__`: train if
 import sys
 
 from code2vec_tpu.config import Config
-from code2vec_tpu.models.jax_model import Code2VecModel
-from code2vec_tpu.serving.interactive_predict import InteractivePredictor
+from code2vec_tpu.parallel.distributed import maybe_initialize
 from code2vec_tpu.vocab.vocabularies import VocabType
 
 
@@ -24,6 +23,12 @@ def main() -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    # Multi-host jobs must initialize the distributed runtime before the
+    # first backend touch; single-host runs detect nothing and continue.
+    maybe_initialize(config.DIST_COORDINATOR, config.DIST_NUM_PROCESSES,
+                     config.DIST_PROCESS_ID, log=config.log)
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from code2vec_tpu.serving.interactive_predict import InteractivePredictor
     model = Code2VecModel(config)
     config.log(f"model loaded: framework=jax backend={config.BACKEND}")
 

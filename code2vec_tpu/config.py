@@ -67,6 +67,13 @@ class Config:
     # embedding-gather-bound. Off by default; safe to enable on TPU.
     USE_PALLAS: bool = False
 
+    # ---- multi-host (SURVEY.md §3.3 comm-backend row): explicit
+    # coordination flags; auto-detection (Cloud TPU pod / Slurm env)
+    # needs no flags. ----
+    DIST_COORDINATOR: Optional[str] = None   # host:port of process 0
+    DIST_NUM_PROCESSES: Optional[int] = None
+    DIST_PROCESS_ID: Optional[int] = None
+
     # ---- CLI surface (reference flag names, SURVEY.md §2 L6) ----
     train_data_path: Optional[str] = None   # --data <prefix>
     test_data_path: Optional[str] = None    # --test <file>
@@ -173,6 +180,13 @@ class Config:
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--seed", dest="seed", type=int, default=None)
+        p.add_argument("--dist_coordinator", dest="dist_coordinator",
+                       default=None,
+                       help="host:port of process 0 for multi-host runs")
+        p.add_argument("--dist_num_processes", dest="dist_num_processes",
+                       type=int, default=None)
+        p.add_argument("--dist_process_id", dest="dist_process_id",
+                       type=int, default=None)
         p.add_argument("--logs-path", dest="logs_path", default=None)
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
@@ -212,6 +226,9 @@ class Config:
             cfg.MESH_MODEL_AXIS = ns.mesh_model
         if ns.seed is not None:
             cfg.SEED = ns.seed
+        cfg.DIST_COORDINATOR = ns.dist_coordinator
+        cfg.DIST_NUM_PROCESSES = ns.dist_num_processes
+        cfg.DIST_PROCESS_ID = ns.dist_process_id
         if ns.logs_path is not None:
             cfg.LOG_PATH = ns.logs_path
         if ns.verbose_mode is not None:

@@ -78,6 +78,20 @@ def parse_c2v_rows(lines: List[str], vocabs: Code2VecVocabs,
     return labels, src, pth, dst, mask, target_strings, context_strings
 
 
+def _aligned_num_batches(global_examples: int, num_host_shards: int,
+                         batch_size: int) -> int:
+    """Number of batches EVERY host must emit per epoch.
+
+    Round-robin sharding gives hosts shard sizes differing by at most 1,
+    so the largest shard has ceil(N/H) examples. Hosts with fewer batches
+    pad with empty (all-weight-zero) batches so every host joins the same
+    number of collective steps — otherwise the epoch deadlocks on the
+    host that runs one extra SPMD step.
+    """
+    largest_shard = -(-global_examples // num_host_shards)
+    return -(-largest_shard // batch_size)
+
+
 def _pad_batch(arrs, batch_size: int):
     """Pad along axis 0 to `batch_size` by repeating zeros/PAD rows."""
     out = []
@@ -133,6 +147,7 @@ class C2VTextReader:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(order)
             self._epoch += 1
+        emitted = 0
         with open(self.path, "r", encoding="utf-8", errors="replace") as f:
             for start in range(0, len(offsets), self.batch_size):
                 idx = order[start:start + self.batch_size]
@@ -146,9 +161,27 @@ class C2VTextReader:
                 nv = len(batch_lines)
                 labels, src, pth, dst, mask = _pad_batch(
                     (labels, src, pth, dst, mask), self.batch_size)
+                emitted += 1
                 yield BatchTensors(labels, src, pth, dst, mask, nv,
                                    tstr if self.keep_strings else None,
                                    cstr if self.keep_strings else None)
+        if self.num_host_shards > 1:
+            target = _aligned_num_batches(len(self._line_offsets()),
+                                          self.num_host_shards,
+                                          self.batch_size)
+            for _ in range(target - emitted):
+                yield self._empty_batch()
+
+    def _empty_batch(self) -> BatchTensors:
+        B, C = self.batch_size, self.max_contexts
+        return BatchTensors(
+            np.zeros((B,), np.int32),
+            np.full((B, C), self.vocabs.token_vocab.pad_index, np.int32),
+            np.full((B, C), self.vocabs.path_vocab.pad_index, np.int32),
+            np.full((B, C), self.vocabs.token_vocab.pad_index, np.int32),
+            np.zeros((B, C), np.float32), 0,
+            [] if self.keep_strings else None,
+            [] if self.keep_strings else None)
 
 
 class BinaryShardReader:
@@ -189,6 +222,7 @@ class BinaryShardReader:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(order)
             self._epoch += 1
+        emitted = 0
         for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]
             rows = np.asarray(self.data[np.sort(idx)])
@@ -200,9 +234,22 @@ class BinaryShardReader:
             nv = rows.shape[0]
             labels, src, pth, dst, mask = _pad_batch(
                 (labels, src, pth, dst, mask), self.batch_size)
+            emitted += 1
             yield BatchTensors(labels, np.ascontiguousarray(src),
                                np.ascontiguousarray(pth),
                                np.ascontiguousarray(dst), mask, nv)
+        if self.num_host_shards > 1:
+            target = _aligned_num_batches(self.num_examples,
+                                          self.num_host_shards,
+                                          self.batch_size)
+            for _ in range(target - emitted):
+                B = self.batch_size
+                yield BatchTensors(
+                    np.zeros((B,), np.int32),
+                    np.full((B, C), self.pad_index, np.int32),
+                    np.full((B, C), self.pad_index, np.int32),
+                    np.full((B, C), self.pad_index, np.int32),
+                    np.zeros((B, C), np.float32), 0)
 
 
 def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,

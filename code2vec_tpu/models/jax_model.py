@@ -25,7 +25,8 @@ from code2vec_tpu.data.reader import (BatchTensors, _pad_batch, open_reader,
                                       parse_c2v_rows)
 from code2vec_tpu.models.encoder import ModelDims, init_params
 from code2vec_tpu.models.model_base import Code2VecModelBase, MetricAccumulator
-from code2vec_tpu.parallel.mesh import make_mesh
+from code2vec_tpu.parallel.distributed import fetch_global
+from code2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
@@ -145,14 +146,18 @@ class Code2VecModel(Code2VecModelBase):
             cfg.MAX_PATH_VOCAB_SIZE, cfg.MAX_TARGET_VOCAB_SIZE)
 
     # ---- helpers ----
-    def _device_batch(self, b: BatchTensors):
+    def _device_batch(self, b: BatchTensors, process_local: bool = True):
+        """process_local=True for training (each host contributes its own
+        shard; global batch scales with host count), False for eval and
+        predict (all hosts feed the same batch)."""
         weights = np.zeros((b.target_index.shape[0],), dtype=np.float32)
         weights[:b.num_valid_examples] = 1.0
         arrays = (b.target_index, b.path_source_token_indices,
                   b.path_indices, b.path_target_token_indices,
                   b.context_valid_mask, weights)
         if self.mesh is not None:
-            return shard_batch(self.mesh, arrays)
+            return shard_batch(self.mesh, arrays,
+                               process_local=process_local)
         return arrays
 
     def _ids_to_words(self, topk_ids: np.ndarray) -> List[List[str]]:
@@ -207,13 +212,13 @@ class Code2VecModel(Code2VecModelBase):
         acc = MetricAccumulator(
             cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)
         for batch in reader:
-            dev_batch = self._device_batch(batch)
+            dev_batch = self._device_batch(batch, process_local=False)
             loss_sum, topk_ids, _ = self._eval_step(self.params, dev_batch)
             nv = batch.num_valid_examples
             names = (batch.target_strings[:nv] if batch.target_strings
                      else [self.vocabs.target_vocab.lookup_word(int(i))
                            for i in batch.target_index[:nv]])
-            words = self._ids_to_words(np.asarray(topk_ids)[:nv])
+            words = self._ids_to_words(fetch_global(topk_ids)[:nv])
             acc.update_batch(names, words, float(loss_sum))
         return acc.results()
 
@@ -230,17 +235,23 @@ class Code2VecModel(Code2VecModelBase):
         # Pad the leading dim to the next power of two: the jitted predict
         # step compiles O(log n) variants instead of one per method count.
         padded_n = max(1, 1 << (n - 1).bit_length())
+        if self.mesh is not None:
+            # batch dim must divide the data axis to shard over the mesh
+            dax = self.mesh.shape[DATA_AXIS]
+            padded_n = -(-padded_n // dax) * dax
         weights = np.zeros((padded_n,), dtype=np.float32)
         weights[:n] = 1.0
         labels, src, pth, dst, mask = _pad_batch(
             (labels, src, pth, dst, mask), padded_n)
         batch = (labels, src, pth, dst, mask, weights)
+        if self.mesh is not None:
+            batch = shard_batch(self.mesh, batch, process_local=False)
         topk_ids, topk_probs, attn, code = self._predict_step(
             self.params, batch)
-        topk_ids = np.asarray(topk_ids)
-        topk_probs = np.asarray(topk_probs)
-        attn = np.asarray(attn)
-        code = np.asarray(code)
+        topk_ids = fetch_global(topk_ids)
+        topk_probs = fetch_global(topk_probs)
+        attn = fetch_global(attn)
+        code = fetch_global(code)
         results = []
         for i, original in enumerate(tstr):
             res = MethodPredictionResults(original_name=original)
@@ -310,8 +321,8 @@ class Code2VecModel(Code2VecModelBase):
                                        compute_dtype=self.compute_dtype)
         with open(dest_path, "w", encoding="utf-8") as f:
             for batch in reader:
-                dev_batch = self._device_batch(batch)
+                dev_batch = self._device_batch(batch, process_local=False)
                 code = encode_step(self.params, dev_batch)
-                code = np.asarray(code)[:batch.num_valid_examples]
+                code = fetch_global(code)[:batch.num_valid_examples]
                 for row in code:
                     f.write(" ".join(f"{x:.6f}" for x in row) + "\n")

@@ -1,0 +1,106 @@
+"""Multi-host initialization and cross-process data movement.
+
+SURVEY.md §3.3 (comm-backend row): the reference is single-process; the
+TPU framework scales to multi-host pod slices by running one JAX process
+per host inside a single SPMD program — XLA collectives over ICI/DCN
+replace the NCCL/MPI backend a GPU framework would carry. This module
+owns the `jax.distributed.initialize` call (which must run before the
+backend is first touched on every process) and the helpers that move
+host data into / out of globally-sharded arrays.
+
+Launch recipe (one command per host):
+
+    python code2vec.py ... --dist_coordinator <host0>:<port> \
+        --dist_num_processes <H> --dist_process_id <i>
+
+or rely on auto-detection: on Cloud TPU pods / Slurm,
+`jax.distributed.initialize()` discovers the topology itself, and this
+module calls it whenever such an environment is detected.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+# Environment markers that indicate "this process is one worker of a
+# multi-host job". Explicit coordination uses JAX_COORDINATOR_ADDRESS;
+# Slurm jobs expose SLURM_NTASKS; Cloud TPU pod slices expose a
+# comma-separated TPU_WORKER_HOSTNAMES (single-host environments set it
+# too, with one entry, so it only counts when it names several hosts).
+_MULTIHOST_ENV_MARKERS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def _looks_multihost() -> bool:
+    if any(os.environ.get(k) for k in _MULTIHOST_ENV_MARKERS):
+        return True
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    return int(os.environ.get("SLURM_NTASKS") or 1) > 1
+
+_initialized = False
+
+
+def maybe_initialize(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     log: Optional[Callable[[str], None]] = None) -> bool:
+    """Call `jax.distributed.initialize` when this looks like (or is
+    explicitly flagged as) one process of a multi-host job.
+
+    Safe to call unconditionally: single-host runs detect nothing and
+    return False without touching the backend. Returns True when the
+    distributed runtime was initialized (or already was).
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    flags = (coordinator_address, num_processes, process_id)
+    if any(f is not None for f in flags) and any(f is None for f in flags):
+        raise ValueError(
+            "--dist_coordinator, --dist_num_processes and "
+            "--dist_process_id must be given together (got "
+            f"coordinator={coordinator_address!r}, "
+            f"num_processes={num_processes!r}, process_id={process_id!r})")
+    explicit = coordinator_address is not None
+    if not (explicit or _looks_multihost()):
+        return False
+
+    import jax
+
+    kwargs = {}
+    if explicit:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes,
+                      process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    if log is not None:
+        log(f"jax.distributed initialized: process "
+            f"{jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / "
+            f"{jax.device_count()} global devices")
+    return True
+
+
+def fetch_global(x):
+    """Bring a (possibly non-fully-addressable) global array to the host
+    as numpy, identical on every process.
+
+    Single-process: plain np.asarray. Multi-process: allgather the
+    process-local shards over the coordination backend so host-side code
+    (metrics, prediction decoding) sees the full batch everywhere.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -66,8 +66,33 @@ def shard_opt_state(mesh: Mesh, opt_state, params):
     return jax.tree_util.tree_map(put, opt_state)
 
 
-def shard_batch(mesh: Mesh, arrays):
-    """device_put a tuple/list of [B, ...] numpy arrays with the batch dim
-    over 'data'."""
+def shard_batch(mesh: Mesh, arrays, *, process_local: bool = True):
+    """Put a tuple of [B, ...] host arrays onto the mesh with the batch
+    dim over 'data'.
+
+    Multi-process semantics depend on what the caller's B means:
+
+    - process_local=True (training): every process passes its OWN disjoint
+      local batch of size B; the global array has batch B * process_count.
+      Built with `jax.make_array_from_process_local_data`, so no process
+      needs the others' data — this is what makes the effective global
+      batch actually scale with host count.
+    - process_local=False (eval/predict): every process passes the SAME
+      value; the global batch stays B, sliced across all devices. Built
+      with `jax.make_array_from_callback`, which only reads the slices
+      owned by this process's devices.
+    """
+    import numpy as np
+
     sh = NamedSharding(mesh, batch_pspec())
-    return tuple(jax.device_put(a, sh) for a in arrays)
+    if jax.process_count() == 1:
+        return tuple(jax.device_put(a, sh) for a in arrays)
+    if process_local:
+        return tuple(
+            jax.make_array_from_process_local_data(sh, np.asarray(a))
+            for a in arrays)
+    return tuple(
+        jax.make_array_from_callback(
+            np.asarray(a).shape, sh,
+            lambda idx, _a=np.asarray(a): _a[idx])
+        for a in arrays)

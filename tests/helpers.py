@@ -38,6 +38,22 @@ def make_raw_lines(n: int, seed: int = 0, max_ctx: int = 12):
     return lines
 
 
+def example_batch(seed: int, dims, batch: int):
+    """Deterministic synthetic device-batch tuple in the train-step format
+    (labels, src, pth, dst, mask, weights)."""
+    import numpy as np
+    r = np.random.default_rng(seed)
+    C = dims.max_contexts
+    labels = r.integers(0, dims.target_vocab_size, (batch,)).astype(np.int32)
+    src = r.integers(0, dims.token_vocab_size, (batch, C)).astype(np.int32)
+    pth = r.integers(0, dims.path_vocab_size, (batch, C)).astype(np.int32)
+    dst = r.integers(0, dims.token_vocab_size, (batch, C)).astype(np.int32)
+    mask = (r.random((batch, C)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    weights = np.ones((batch,), dtype=np.float32)
+    return labels, src, pth, dst, mask, weights
+
+
 def build_tiny_dataset(tmpdir: str, n_train: int = 256, n_val: int = 32,
                        n_test: int = 64, max_contexts: int = 16,
                        binarize: bool = False) -> str:

@@ -1,0 +1,102 @@
+"""Worker process for the 2-process multi-host test.
+
+Usage: python mp_worker.py <process_id> <port> <out_dir>
+
+Each of the two processes provisions 4 local CPU devices, joins a
+2-process distributed runtime (8 global devices), and runs ONE training
+step over a global ('data','model') mesh with its own PROCESS-LOCAL half
+of the batch — exactly the multi-host feed path of jax_model.train. It
+writes the resulting loss and a parameter checksum for the parent test to
+compare against a single-process oracle.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, port, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=pid)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.parallel.distributed import fetch_global
+    from code2vec_tpu.parallel.mesh import make_mesh
+    from code2vec_tpu.parallel.sharding import (shard_batch,
+                                                shard_opt_state,
+                                                shard_params)
+    from code2vec_tpu.training.steps import make_eval_step, make_train_step
+    from helpers import example_batch
+
+    assert jax.process_count() == 2 and jax.device_count() == 8
+
+    dims = ModelDims(token_vocab_size=64, path_vocab_size=48,
+                     target_vocab_size=40, embeddings_size=16,
+                     max_contexts=8, dropout_keep_rate=1.0,
+                     vocab_pad_multiple=2)
+    mesh = make_mesh(4, 2)
+
+    params = init_params(jax.random.PRNGKey(0), dims)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    params = shard_params(mesh, params)
+    opt_state = shard_opt_state(mesh, opt_state, params)
+
+    # --- train: process-local half-batch; global batch = 8 + 8 ---
+    local = example_batch(seed=pid, dims=dims, batch=8)
+    batch = shard_batch(mesh, local, process_local=True)
+    assert batch[0].shape[0] == 16, batch[0].shape  # B scales with hosts
+
+    step = make_train_step(dims, optimizer, compute_dtype=jnp.float32)
+    params, opt_state, loss = step(params, opt_state, batch,
+                                   jax.random.PRNGKey(7))
+
+    # --- eval: identical batch on both hosts; global batch stays 8 ---
+    eval_local = example_batch(seed=99, dims=dims, batch=8)
+    eval_batch = shard_batch(mesh, eval_local, process_local=False)
+    assert eval_batch[0].shape[0] == 8, eval_batch[0].shape
+    eval_step = make_eval_step(dims, top_k=3, compute_dtype=jnp.float32)
+    loss_sum, topk_ids, _ = eval_step(params, eval_batch)
+    topk_host = fetch_global(topk_ids)
+
+    # --- checkpoint save: orbax saves are collectives, every process
+    # participates (jax_model.save does the same in train()) ---
+    from code2vec_tpu.training import checkpoint as ckpt
+    from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, Vocab, \
+        VocabType
+    vocabs = Code2VecVocabs(
+        Vocab(VocabType.Token, ["a", "b"]),
+        Vocab(VocabType.Path, ["1"]),
+        Vocab(VocabType.Target, ["t"]))
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    ckpt.save_checkpoint(ckpt_dir, {"params": params,
+                                    "opt_state": opt_state, "step": 1},
+                         1, vocabs, dims)
+    restored = ckpt.load_checkpoint(ckpt_dir, {"params": params,
+                                               "opt_state": opt_state,
+                                               "step": 0})
+    restored_checksum = float(sum(
+        jnp.sum(fetch_global(v).astype(np.float64))
+        for v in restored["params"].values()))
+
+    checksum = float(sum(jnp.sum(fetch_global(v).astype(np.float64))
+                         for v in params.values()))
+    np.savez(os.path.join(out_dir, f"proc{pid}.npz"),
+             loss=float(loss), checksum=checksum,
+             restored_checksum=restored_checksum,
+             eval_loss=float(loss_sum), topk=np.asarray(topk_host))
+
+
+if __name__ == "__main__":
+    main()

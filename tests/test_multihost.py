@@ -1,0 +1,209 @@
+"""Multi-host correctness, tested with REAL separate processes.
+
+SURVEY.md §3.3 (comm-backend row) + §5 ("multi-node without a cluster"):
+two OS processes, 4 CPU devices each, joined via
+`jax.distributed.initialize` with Gloo collectives — the same code path a
+multi-host TPU pod slice runs. Verifies:
+
+- the train feed scales the GLOBAL batch with host count (each process
+  contributes a disjoint local half via
+  `jax.make_array_from_process_local_data` — the ADVICE round-1 fix),
+- the 2-process step numerics equal a single-process 8-device step over
+  the concatenated batch,
+- the eval feed keeps the global batch un-scaled (identical data on all
+  hosts) and `fetch_global` returns full outputs on every process,
+- the host-shard readers partition the example space disjointly.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from helpers import example_batch
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mp_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def two_process_results(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("mp"))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers provision their own devices
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), str(port), out_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    return {i: np.load(os.path.join(out_dir, f"proc{i}.npz"))
+            for i in range(2)}
+
+
+def test_two_processes_agree(two_process_results):
+    r0, r1 = two_process_results[0], two_process_results[1]
+    assert np.isfinite(r0["loss"])
+    np.testing.assert_allclose(r0["loss"], r1["loss"], rtol=1e-6)
+    np.testing.assert_allclose(r0["checksum"], r1["checksum"], rtol=1e-6)
+    np.testing.assert_allclose(r0["eval_loss"], r1["eval_loss"], rtol=1e-6)
+    np.testing.assert_array_equal(r0["topk"], r1["topk"])
+    # cross-host orbax save -> restore round-trips the params
+    np.testing.assert_allclose(r0["restored_checksum"], r0["checksum"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(r1["restored_checksum"], r1["checksum"],
+                               rtol=1e-6)
+
+
+def test_two_process_step_matches_single_process_oracle(
+        two_process_results):
+    """Single-process 8-device mesh over the concatenated (proc0 ++ proc1)
+    batch must produce the same loss and updated params: multi-host is a
+    pure re-distribution, not a numerics change."""
+    from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.parallel.mesh import make_mesh
+    from code2vec_tpu.parallel.sharding import (shard_batch,
+                                                shard_opt_state,
+                                                shard_params)
+    from code2vec_tpu.training.steps import make_eval_step, make_train_step
+
+    dims = ModelDims(token_vocab_size=64, path_vocab_size=48,
+                     target_vocab_size=40, embeddings_size=16,
+                     max_contexts=8, dropout_keep_rate=1.0,
+                     vocab_pad_multiple=2)
+    mesh = make_mesh(4, 2)
+    params = init_params(jax.random.PRNGKey(0), dims)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    params = shard_params(mesh, params)
+    opt_state = shard_opt_state(mesh, opt_state, params)
+
+    halves = [example_batch(seed=i, dims=dims, batch=8) for i in range(2)]
+    batch = shard_batch(mesh, tuple(
+        np.concatenate([halves[0][k], halves[1][k]]) for k in range(6)))
+
+    step = make_train_step(dims, optimizer, compute_dtype=jnp.float32)
+    params, opt_state, loss = step(params, opt_state, batch,
+                                   jax.random.PRNGKey(7))
+
+    r0 = two_process_results[0]
+    np.testing.assert_allclose(float(loss), r0["loss"], rtol=1e-5)
+    checksum = float(sum(np.sum(np.asarray(v, dtype=np.float64))
+                         for v in params.values()))
+    np.testing.assert_allclose(checksum, r0["checksum"], rtol=1e-5)
+
+    eval_batch = shard_batch(mesh, example_batch(seed=99, dims=dims,
+                                                 batch=8))
+    eval_step = make_eval_step(dims, top_k=3, compute_dtype=jnp.float32)
+    loss_sum, topk_ids, _ = eval_step(params, eval_batch)
+    np.testing.assert_allclose(float(loss_sum), r0["eval_loss"],
+                               rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(topk_ids), r0["topk"])
+
+
+def _unique_target_dataset(tmpdir: str, n: int):
+    """A dataset whose n examples carry n UNIQUE target labels, so shard
+    contents are identifiable per-example."""
+    from code2vec_tpu.data import binarize as binarize_mod
+    from code2vec_tpu.data import preprocess as preprocess_mod
+
+    raw = os.path.join(tmpdir, "raw.txt")
+    with open(raw, "w") as f:
+        for i in range(n):
+            f.write(f"m|{i} tok{i % 5},{1000 + i % 7},tok{i % 3}\n")
+    prefix = os.path.join(tmpdir, "uniq")
+    args = ["--train_data", raw, "--val_data", raw, "--test_data", raw,
+            "--max_contexts", "4", "--word_vocab_size", "1000",
+            "--path_vocab_size", "1000", "--target_vocab_size", "1000",
+            "--output_name", prefix]
+    preprocess_mod.main(args)
+    binarize_mod.main(["--data", prefix, "--max_contexts", "4",
+                       "--word_vocab_size", "1000",
+                       "--path_vocab_size", "1000",
+                       "--target_vocab_size", "1000"])
+    return prefix
+
+
+def test_host_shard_readers_partition_disjointly(tmp_path):
+    """Each (host_shard, num_host_shards) reader must see a disjoint
+    slice whose union is EXACTLY the full example set — text and binary
+    paths, checked per-example via unique target labels."""
+    from code2vec_tpu.data.reader import open_reader
+    from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+    N = 64
+    prefix = _unique_target_dataset(str(tmp_path), N)
+    vocabs = Code2VecVocabs.load_from_dict_file(
+        prefix + ".dict.c2v", 1000, 1000, 1000)
+
+    for use_binary in (True, False):
+        shards = []
+        for shard in range(3):
+            reader = open_reader(
+                prefix + ".train.c2v", vocabs, 4, batch_size=8,
+                shuffle=False, keep_strings=not use_binary,
+                host_shard=shard, num_host_shards=3)
+            ids = set()
+            for b in reader:
+                nv = b.num_valid_examples
+                if use_binary or not b.target_strings:
+                    ids.update(int(i) for i in b.target_index[:nv])
+                else:
+                    ids.update(vocabs.target_vocab.lookup_index(s)
+                               for s in b.target_strings[:nv])
+            shards.append(ids)
+        for a in range(3):
+            for b in range(a + 1, 3):
+                assert not (shards[a] & shards[b]), (use_binary, a, b)
+        union = set().union(*shards)
+        assert len(union) == N, (use_binary, len(union))
+
+
+def test_host_shard_readers_emit_aligned_batch_counts(tmp_path):
+    """With H hosts and a shard-size imbalance, every host must emit the
+    SAME number of batches (short hosts pad with weight-zero batches) or
+    the collective train step deadlocks."""
+    from code2vec_tpu.data.reader import open_reader
+    from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+    # 17 examples, H=2, B=8: host 0 gets 9 (2 batches), host 1 gets 8
+    # (1 batch) -> host 1 must pad to 2.
+    prefix = _unique_target_dataset(str(tmp_path), 17)
+    vocabs = Code2VecVocabs.load_from_dict_file(
+        prefix + ".dict.c2v", 1000, 1000, 1000)
+
+    for use_binary in (True, False):
+        counts, valids = [], []
+        for shard in range(2):
+            reader = open_reader(
+                prefix + ".train.c2v", vocabs, 4, batch_size=8,
+                shuffle=False, keep_strings=not use_binary,
+                host_shard=shard, num_host_shards=2)
+            batches = list(reader)
+            counts.append(len(batches))
+            valids.append([b.num_valid_examples for b in batches])
+        assert counts[0] == counts[1] == 2, (use_binary, counts)
+        assert valids[0] == [8, 1], (use_binary, valids)
+        assert valids[1] == [8, 0], (use_binary, valids)
