@@ -10,12 +10,14 @@ examples/sec * MAX_CONTEXTS(200), measured over the jitted training step
 (sampled softmax over the 261K-name target vocab — the north-star
 java-large configuration; full vocab tables at reference capacity).
 
-Baseline denominator: BASELINE.md records no published reference
-throughput (empty mount; see SURVEY.md §7). We use an estimated
-single-V100 TF1 reference throughput of 3500 examples/s (700_000
-path-contexts/s) — community-reported magnitude for code2vec's TF training
-at batch 1024 on V100; re-verify when the reference runs
-(BASELINE.md action item 2).
+Baseline denominator: derived, methodology-documented single-V100
+estimate of the reference step (fp32, full softmax, dense Adam, input
+pipeline assumed free — every assumption favoring the reference):
+1.94M path-contexts/s, the midpoint of the 1.67M-2.20M device-bound band
+computed by tools/v100_roofline.py and anchored against a real TF 2.21
+execution of the same graph math by tools/tf_baseline.py. See
+BASELINE.md "Baseline denominator". The community-anecdote figure used
+in round 1 (700K) survives only as the real-world lower bound.
 """
 
 from __future__ import annotations
@@ -25,7 +27,8 @@ import time
 
 import numpy as np
 
-V100_BASELINE_PATH_CONTEXTS_PER_SEC = 700_000.0
+V100_BASELINE_PATH_CONTEXTS_PER_SEC = 1_940_000.0  # tools/v100_roofline.py
+V100_BASELINE_BAND = (1_675_000.0, 2_197_000.0)
 
 # java-large capacities (SURVEY.md §3 config row)
 TOKEN_VOCAB = 1_301_136
@@ -46,10 +49,14 @@ def main() -> None:
     from code2vec_tpu.models.encoder import ModelDims, init_params
     from code2vec_tpu.training.steps import make_train_step
 
+    # the shipped default config (config.py): bf16 tables (quality-
+    # validated in BASELINE.md's 50K-vocab study), bf16 compute, Pallas
+    # pool on TPU, sampled softmax, dense Adam
     dims = ModelDims(token_vocab_size=TOKEN_VOCAB,
                      path_vocab_size=PATH_VOCAB,
                      target_vocab_size=TARGET_VOCAB,
-                     embeddings_size=128, max_contexts=MAX_CONTEXTS)
+                     embeddings_size=128, max_contexts=MAX_CONTEXTS,
+                     tables_dtype="bfloat16")
     params = init_params(jax.random.PRNGKey(0), dims)
     optimizer = optax.adam(1e-3)
     opt_state = optimizer.init(params)
@@ -98,9 +105,17 @@ def main() -> None:
         "metric": "path-contexts/sec/chip",
         "value": round(value, 1),
         "unit": "path-contexts/sec/chip (java-large, sampled softmax, "
-                "batch 1024, bf16)",
+                "batch 1024, bf16 compute + bf16 tables)",
         "vs_baseline": round(value / V100_BASELINE_PATH_CONTEXTS_PER_SEC,
                              3),
+        "baseline_denominator": V100_BASELINE_PATH_CONTEXTS_PER_SEC,
+        "baseline_band": V100_BASELINE_BAND,
+        "baseline_methodology": "measured-anchored V100 estimate "
+                                "(tools/v100_roofline.py + "
+                                "tools/tf_baseline.py; BASELINE.md)",
+        "vs_baseline_band": [
+            round(value / V100_BASELINE_BAND[1], 3),
+            round(value / V100_BASELINE_BAND[0], 3)],
     }))
 
 

@@ -27,9 +27,29 @@ def main() -> int:
     # first backend touch; single-host runs detect nothing and continue.
     maybe_initialize(config.DIST_COORDINATOR, config.DIST_NUM_PROCESSES,
                      config.DIST_PROCESS_ID, log=config.log)
-    from code2vec_tpu.models.jax_model import Code2VecModel
+    # A checkpoint knows which head trained it; adopt (or cross-check)
+    # the manifest so `--load <vm_ckpt>` works without re-passing --head.
+    if config.is_loading:
+        import json
+        import os
+        mpath = os.path.join(config.load_path, "manifest.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                ckpt_head = json.load(f).get("head", "code2vec")
+            if config.HEAD_EXPLICIT and ckpt_head != config.HEAD:
+                print(f"error: checkpoint was trained with --head "
+                      f"{ckpt_head}, but --head {config.HEAD} was given",
+                      file=sys.stderr)
+                return 2
+            config.HEAD = ckpt_head
+
     from code2vec_tpu.serving.interactive_predict import InteractivePredictor
-    model = Code2VecModel(config)
+    if config.HEAD == "varmisuse":
+        from code2vec_tpu.models.vm_model import VarMisuseModel
+        model = VarMisuseModel(config)
+    else:
+        from code2vec_tpu.models.jax_model import Code2VecModel
+        model = Code2VecModel(config)
     config.log(f"model loaded: framework=jax backend={config.BACKEND}")
 
     if config.release:

@@ -61,11 +61,29 @@ class Config:
     # off; flip on for configs where the tables dwarf HBM or scatters
     # are fast.
     SPARSE_EMBEDDING_UPDATES: bool = False
+    # Storage dtype for the three vocab tables. bf16 halves the
+    # gather/scatter/optimizer HBM traffic dominating java-large steps
+    # (+~40% throughput measured on v5e-lite) and matched (slightly
+    # beat) f32 subtoken-F1 in the 50K-vocab quality study — both in
+    # BASELINE.md — so it is the default; --tables_dtype float32
+    # restores exact reference numerics.
+    TABLES_DTYPE: str = "bfloat16"  # "float32" | "bfloat16"
+    # Optimizer for the vocab tables: "adam" (reference parity) or
+    # "adafactor" (factored second moment, no momentum — the standard
+    # large-embedding-table practice; see training/optimizers.py).
+    EMBEDDING_OPTIMIZER: str = "adam"
     # Fused Pallas attention-pool kernel (ops/pallas_attention.py):
     # ~1.5x faster than the XLA pool in isolation on v5e (4.9 vs 7.7 ms
-    # at B=1024); end-to-end gain is smaller because steps are
-    # embedding-gather-bound. Off by default; safe to enable on TPU.
-    USE_PALLAS: bool = False
+    # at B=1024). Default on; it only takes effect on a TPU backend
+    # (the model silently falls back to the XLA pool elsewhere).
+    USE_PALLAS: bool = True
+
+    # ---- task head: "code2vec" (method-name prediction, reference
+    # parity) or "varmisuse" (pointer-style variable-misuse repair,
+    # BASELINE.json configs[3]; models/varmisuse.py). ----
+    HEAD: str = "code2vec"
+    HEAD_EXPLICIT: bool = False  # True when --head was given on the CLI
+    MAX_CANDIDATES: int = 8   # varmisuse pointer-candidate slots
 
     # ---- multi-host (SURVEY.md §3.3 comm-backend row): explicit
     # coordination flags; auto-detection (Cloud TPU pod / Slurm env)
@@ -177,6 +195,14 @@ class Config:
         p.add_argument("--sampled_softmax", dest="sampled_softmax",
                        action="store_true")
         p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
+        p.add_argument("--head", dest="head", default=None,
+                       choices=["code2vec", "varmisuse"])
+        p.add_argument("--max_candidates", dest="max_candidates",
+                       type=int, default=None)
+        p.add_argument("--tables_dtype", dest="tables_dtype", default=None,
+                       choices=["float32", "bfloat16"])
+        p.add_argument("--embedding_optimizer", dest="embedding_optimizer",
+                       default=None, choices=["adam", "adafactor"])
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--seed", dest="seed", type=int, default=None)
@@ -220,6 +246,15 @@ class Config:
             cfg.USE_SAMPLED_SOFTMAX = True
         if ns.num_sampled is not None:
             cfg.NUM_SAMPLED_CLASSES = ns.num_sampled
+        if ns.head is not None:
+            cfg.HEAD = ns.head
+        cfg.HEAD_EXPLICIT = ns.head is not None
+        if ns.max_candidates is not None:
+            cfg.MAX_CANDIDATES = ns.max_candidates
+        if ns.tables_dtype is not None:
+            cfg.TABLES_DTYPE = ns.tables_dtype
+        if ns.embedding_optimizer is not None:
+            cfg.EMBEDDING_OPTIMIZER = ns.embedding_optimizer
         if ns.mesh_data is not None:
             cfg.MESH_DATA_AXIS = ns.mesh_data
         if ns.mesh_model is not None:
@@ -249,6 +284,19 @@ class Config:
             raise ValueError("MAX_CONTEXTS must be positive.")
         if self.USE_SAMPLED_SOFTMAX and self.NUM_SAMPLED_CLASSES <= 0:
             raise ValueError("NUM_SAMPLED_CLASSES must be positive.")
+        if self.HEAD == "varmisuse" and (self.is_predict or self.release
+                                         or self.save_w2v
+                                         or self.save_t2v
+                                         or self.export_code_vectors):
+            raise ValueError(
+                "--predict/--release/--save_w2v/--save_t2v/"
+                "--export_code_vectors apply to the code2vec head only.")
+        if self.SPARSE_EMBEDDING_UPDATES and (
+                self.TABLES_DTYPE != "float32"
+                or self.EMBEDDING_OPTIMIZER != "adam"):
+            raise ValueError(
+                "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
+                "the adam embedding optimizer.")
 
     def get_logger(self) -> logging.Logger:
         if self._logger is None:

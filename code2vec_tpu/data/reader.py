@@ -155,22 +155,28 @@ class C2VTextReader:
                 for off in offsets[idx]:
                     f.seek(off)
                     batch_lines.append(f.readline())
-                labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
-                    batch_lines, self.vocabs, self.max_contexts,
-                    self.keep_strings)
-                nv = len(batch_lines)
-                labels, src, pth, dst, mask = _pad_batch(
-                    (labels, src, pth, dst, mask), self.batch_size)
                 emitted += 1
-                yield BatchTensors(labels, src, pth, dst, mask, nv,
-                                   tstr if self.keep_strings else None,
-                                   cstr if self.keep_strings else None)
+                yield self._parse_batch(batch_lines)
         if self.num_host_shards > 1:
             target = _aligned_num_batches(len(self._line_offsets()),
                                           self.num_host_shards,
                                           self.batch_size)
             for _ in range(target - emitted):
                 yield self._empty_batch()
+
+    # Subclasses (e.g. the VarMisuse reader) override these two to reuse
+    # the offset-streaming / shuffle / host-shard / aligned-batch loop
+    # above with a different row format.
+    def _parse_batch(self, batch_lines: List[str]) -> BatchTensors:
+        labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
+            batch_lines, self.vocabs, self.max_contexts,
+            self.keep_strings)
+        nv = len(batch_lines)
+        labels, src, pth, dst, mask = _pad_batch(
+            (labels, src, pth, dst, mask), self.batch_size)
+        return BatchTensors(labels, src, pth, dst, mask, nv,
+                            tstr if self.keep_strings else None,
+                            cstr if self.keep_strings else None)
 
     def _empty_batch(self) -> BatchTensors:
         B, C = self.batch_size, self.max_contexts

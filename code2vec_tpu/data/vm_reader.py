@@ -1,0 +1,158 @@
+"""Reader for `.vm.c2v` VarMisuse rows (data/varmisuse_gen.py format):
+
+    <label_idx> <cand_1,...,cand_K> <ctx> <ctx> ...
+
+Streams via the same offset machinery as data/reader.py's C2VTextReader
+(subclass: shuffle, host sharding, and multi-host aligned batch counts
+come from there — VM files can be production-scale without slurping the
+host's memory). Rows whose true candidate falls beyond `max_candidates`
+get `row_valid = 0` so they are excluded from the loss instead of
+training toward a wrong candidate.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from code2vec_tpu.data.reader import C2VTextReader
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+
+class VMBatch(NamedTuple):
+    label: np.ndarray           # int32 [B] index into candidates
+    path_source_token_indices: np.ndarray  # int32 [B, C]
+    path_indices: np.ndarray    # int32 [B, C]
+    path_target_token_indices: np.ndarray  # int32 [B, C]
+    context_valid_mask: np.ndarray  # f32 [B, C]
+    cand_ids: np.ndarray        # int32 [B, K] token-vocab ids
+    cand_mask: np.ndarray       # f32 [B, K]
+    row_valid: np.ndarray       # f32 [B]; 0 = drop from loss/metrics
+    num_valid_examples: int
+    cand_strings: List[List[str]]
+
+
+def parse_vm_rows(lines: List[str], vocabs: Code2VecVocabs,
+                  max_contexts: int, max_candidates: int):
+    n = len(lines)
+    tok_v, path_v = vocabs.token_vocab, vocabs.path_vocab
+    labels = np.zeros((n,), np.int32)
+    src = np.full((n, max_contexts), tok_v.pad_index, np.int32)
+    pth = np.full((n, max_contexts), path_v.pad_index, np.int32)
+    dst = np.full((n, max_contexts), tok_v.pad_index, np.int32)
+    mask = np.zeros((n, max_contexts), np.float32)
+    cand = np.full((n, max_candidates), tok_v.pad_index, np.int32)
+    cand_mask = np.zeros((n, max_candidates), np.float32)
+    row_valid = np.ones((n,), np.float32)
+    cand_strings: List[List[str]] = []
+    for i, line in enumerate(lines):
+        parts = line.rstrip("\n").split(" ")
+        labels[i] = int(parts[0])
+        cands = [c for c in parts[1].split(",") if c][:max_candidates]
+        cand_strings.append(cands)
+        for k, c in enumerate(cands):
+            cand[i, k] = tok_v.lookup_index(c)
+            cand_mask[i, k] = 1.0
+        if labels[i] >= len(cands):
+            # true candidate truncated away: keep the label in-range for
+            # jit but exclude the row from loss/metrics entirely
+            labels[i] = 0
+            row_valid[i] = 0.0
+        for j, ctx in enumerate(parts[2:2 + max_contexts]):
+            fields = ctx.split(",")
+            if len(fields) != 3 or not fields[1]:
+                continue
+            src[i, j] = tok_v.lookup_index(fields[0])
+            pth[i, j] = path_v.lookup_index(fields[1])
+            dst[i, j] = tok_v.lookup_index(fields[2])
+            mask[i, j] = 1.0
+    return (labels, src, pth, dst, mask, cand, cand_mask, row_valid,
+            cand_strings)
+
+
+class VMTextReader(C2VTextReader):
+    """Offset-streaming reader over a `.vm.c2v` file."""
+
+    def __init__(self, path: str, vocabs: Code2VecVocabs,
+                 max_contexts: int, max_candidates: int, batch_size: int,
+                 shuffle: bool = False, seed: int = 0,
+                 host_shard: int = 0, num_host_shards: int = 1):
+        super().__init__(path, vocabs, max_contexts, batch_size,
+                         shuffle=shuffle, seed=seed,
+                         host_shard=host_shard,
+                         num_host_shards=num_host_shards)
+        self.max_candidates = max_candidates
+
+    def _parse_batch(self, batch_lines: List[str]) -> VMBatch:
+        (labels, src, pth, dst, mask, cand, cand_mask, row_valid,
+         cand_strings) = parse_vm_rows(batch_lines, self.vocabs,
+                                       self.max_contexts,
+                                       self.max_candidates)
+        nv = len(batch_lines)
+        pad = self.batch_size - nv
+        if pad:
+            tokp = self.vocabs.token_vocab.pad_index
+            pthp = self.vocabs.path_vocab.pad_index
+            labels = np.pad(labels, (0, pad))
+            src = np.pad(src, ((0, pad), (0, 0)), constant_values=tokp)
+            pth = np.pad(pth, ((0, pad), (0, 0)), constant_values=pthp)
+            dst = np.pad(dst, ((0, pad), (0, 0)), constant_values=tokp)
+            mask = np.pad(mask, ((0, pad), (0, 0)))
+            cand = np.pad(cand, ((0, pad), (0, 0)), constant_values=tokp)
+            cand_mask = np.pad(cand_mask, ((0, pad), (0, 0)))
+            row_valid = np.pad(row_valid, (0, pad))
+            # padded rows need one unmasked candidate so softmax stays
+            # finite; row_valid/weights zero them out of the loss
+            cand_mask[nv:, 0] = 1.0
+        return VMBatch(labels, src, pth, dst, mask, cand, cand_mask,
+                       row_valid, nv, cand_strings)
+
+    def _empty_batch(self) -> VMBatch:
+        B, C, K = self.batch_size, self.max_contexts, self.max_candidates
+        tokp = self.vocabs.token_vocab.pad_index
+        pthp = self.vocabs.path_vocab.pad_index
+        cm = np.zeros((B, K), np.float32)
+        cm[:, 0] = 1.0
+        return VMBatch(
+            np.zeros((B,), np.int32),
+            np.full((B, C), tokp, np.int32),
+            np.full((B, C), pthp, np.int32),
+            np.full((B, C), tokp, np.int32),
+            np.zeros((B, C), np.float32),
+            np.full((B, K), tokp, np.int32), cm,
+            np.zeros((B,), np.float32), 0, [])
+
+
+def build_vm_vocabs(train_path: str, max_token_vocab: int,
+                    max_path_vocab: int) -> Code2VecVocabs:
+    """VarMisuse vocabularies from the training rows themselves (tokens
+    + paths; the 'target' vocab is the candidate pointer space, so the
+    target table is unused — kept minimal)."""
+    from collections import Counter
+
+    from code2vec_tpu.vocab.vocabularies import Vocab, VocabType
+
+    tok_counts: Counter = Counter()
+    path_counts: Counter = Counter()
+    with open(train_path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.strip().split(" ")
+            if len(parts) < 3:
+                continue
+            for c in parts[1].split(","):
+                if c:
+                    tok_counts[c] += 1
+            for ctx in parts[2:]:
+                fields = ctx.split(",")
+                if len(fields) != 3 or not fields[1]:
+                    continue
+                tok_counts[fields[0]] += 1
+                tok_counts[fields[2]] += 1
+                path_counts[fields[1]] += 1
+    return Code2VecVocabs(
+        Vocab.create_from_freq_dict(VocabType.Token, tok_counts,
+                                    max_token_vocab),
+        Vocab.create_from_freq_dict(VocabType.Path, path_counts,
+                                    max_path_vocab),
+        Vocab.create_from_freq_dict(VocabType.Target, {"method": 1}, 10))

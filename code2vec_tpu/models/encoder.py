@@ -41,6 +41,11 @@ class ModelDims:
     dropout_keep_rate: float = 0.75
     # Row padding so vocab dims divide the 'model' mesh axis evenly.
     vocab_pad_multiple: int = 1
+    # Storage dtype of the three vocab tables ("float32" | "bfloat16").
+    # bf16 tables halve the gather / scatter / optimizer HBM traffic that
+    # dominates the java-large step (~30-40% end-to-end, measured on
+    # v5e-lite; see BASELINE.md). TRANSFORM/ATTENTION always stay f32.
+    tables_dtype: str = "float32"
 
     @property
     def context_vector_size(self) -> int:
@@ -58,19 +63,22 @@ class ModelDims:
 def init_params(rng: jax.Array, dims: ModelDims,
                 dtype=jnp.float32) -> Params:
     """Variance-scaled init, matching the reference's scheme in spirit
-    (TF used glorot-ish initializers on the tables and TRANSFORM)."""
+    (TF used glorot-ish initializers on the tables and TRANSFORM).
+    The vocab tables are stored in dims.tables_dtype; TRANSFORM and
+    ATTENTION stay in `dtype` (f32) for numerics."""
     k_tok, k_path, k_tgt, k_tr, k_at = jax.random.split(rng, 5)
     E = dims.embeddings_size
     D = dims.context_vector_size
     init = jax.nn.initializers.variance_scaling(
         1.0, "fan_avg", "uniform")
+    t_dtype = jnp.dtype(dims.tables_dtype)
     return {
         "token_emb": init(k_tok, (dims.padded(dims.token_vocab_size), E),
-                          dtype),
+                          t_dtype),
         "path_emb": init(k_path, (dims.padded(dims.path_vocab_size), E),
-                         dtype),
+                         t_dtype),
         "target_emb": init(k_tgt, (dims.padded(dims.target_vocab_size), D),
-                           dtype),
+                           t_dtype),
         "transform": init(k_tr, (D, D), dtype),
         "attention": init(k_at, (D, 1), dtype)[:, 0],
     }

@@ -41,6 +41,10 @@ class Code2VecModel(Code2VecModelBase):
         cfg = config
         self.log = cfg.log
         self.compute_dtype = jnp.bfloat16 if cfg.USE_BF16 else jnp.float32
+        # Pallas kernels are TPU-only; fall back to the XLA pool
+        # elsewhere (tests run on the virtual CPU mesh).
+        self.use_pallas = (cfg.USE_PALLAS
+                           and jax.default_backend() == "tpu")
 
         # ---- mesh (SURVEY.md §3.3): data axis for DP, model axis for
         # sharded vocab tables; single-device runs use no mesh. ----
@@ -63,6 +67,9 @@ class Code2VecModel(Code2VecModelBase):
                 "num_sampled", cfg.NUM_SAMPLED_CLASSES)
             cfg.SPARSE_EMBEDDING_UPDATES = manifest.get(
                 "sparse_embedding_updates", cfg.SPARSE_EMBEDDING_UPDATES)
+            cfg.TABLES_DTYPE = self.dims.tables_dtype
+            cfg.EMBEDDING_OPTIMIZER = manifest.get(
+                "embedding_optimizer", cfg.EMBEDDING_OPTIMIZER)
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -72,8 +79,11 @@ class Code2VecModel(Code2VecModelBase):
                 max_contexts=cfg.MAX_CONTEXTS,
                 dropout_keep_rate=cfg.DROPOUT_KEEP_RATE,
                 vocab_pad_multiple=model_axis,
+                tables_dtype=cfg.TABLES_DTYPE,
             )
-        self.optimizer = optax.adam(cfg.LEARNING_RATE)
+        from code2vec_tpu.training.optimizers import make_optimizer
+        self.optimizer = make_optimizer(cfg.LEARNING_RATE,
+                                        cfg.EMBEDDING_OPTIMIZER)
         self.rng = jax.random.PRNGKey(cfg.SEED)
 
         # ---- params: load (--load) or init ----
@@ -124,14 +134,14 @@ class Code2VecModel(Code2VecModelBase):
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
                 num_sampled=cfg.NUM_SAMPLED_CLASSES,
                 compute_dtype=self.compute_dtype,
-                use_pallas=cfg.USE_PALLAS)
+                use_pallas=self.use_pallas)
         top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
         self._eval_step = make_eval_step(self.dims, top_k=top_k,
                                          compute_dtype=self.compute_dtype,
-                                         use_pallas=cfg.USE_PALLAS)
+                                         use_pallas=self.use_pallas)
         self._predict_step = make_predict_step(
             self.dims, top_k=top_k, compute_dtype=self.compute_dtype,
-            use_pallas=cfg.USE_PALLAS)
+            use_pallas=self.use_pallas)
 
     # ---- vocabs: dataset dict when training, checkpoint sidecar when
     # loading (SURVEY.md §3.2 "Model checkpoint") ----
@@ -289,7 +299,8 @@ class Code2VecModel(Code2VecModelBase):
         extra = {"use_sampled_softmax": self.config.USE_SAMPLED_SOFTMAX,
                  "num_sampled": self.config.NUM_SAMPLED_CLASSES,
                  "sparse_embedding_updates":
-                     self.config.SPARSE_EMBEDDING_UPDATES}
+                     self.config.SPARSE_EMBEDDING_UPDATES,
+                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

@@ -1,0 +1,211 @@
+"""VarMisuse model orchestration (BASELINE.json configs[3]).
+
+Mirrors models/jax_model.py's lifecycle (train / evaluate / save / load /
+resume) for the pointer head in models/varmisuse.py, over `.vm.c2v`
+datasets (data/varmisuse_gen.py format). Selected via `--head varmisuse`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.vm_reader import (VMTextReader, build_vm_vocabs)
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.models.varmisuse import init_vm_params
+from code2vec_tpu.parallel.distributed import fetch_global
+from code2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
+                                            shard_params)
+from code2vec_tpu.training import checkpoint as ckpt
+from code2vec_tpu.training.optimizers import make_optimizer
+from code2vec_tpu.training.vm_steps import (make_vm_eval_step,
+                                            make_vm_train_step)
+
+
+class VMEvalResults(NamedTuple):
+    loss: float
+    accuracy: float
+    num_examples: int
+
+    def __str__(self) -> str:
+        return (f"vm loss: {self.loss:.5f}, pointer accuracy: "
+                f"{self.accuracy:.5f} over {self.num_examples} examples")
+
+
+class VarMisuseModel:
+    def __init__(self, config: Config):
+        cfg = self.config = config
+        self.log = cfg.log
+        self.compute_dtype = jnp.bfloat16 if cfg.USE_BF16 else jnp.float32
+        # Pallas kernels are TPU-only; fall back to the XLA pool
+        # elsewhere (tests run on the virtual CPU mesh).
+        self.use_pallas = (cfg.USE_PALLAS
+                           and jax.default_backend() == "tpu")
+
+        n_dev = len(jax.devices())
+        self.mesh = None
+        model_axis = max(1, cfg.MESH_MODEL_AXIS)
+        if n_dev > 1 or model_axis > 1:
+            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis)
+
+        if cfg.is_loading:
+            self.dims = ckpt.load_dims(cfg.load_path)
+            cfg.MAX_CONTEXTS = self.dims.max_contexts
+            manifest = ckpt.load_manifest(cfg.load_path)
+            cfg.MAX_CANDIDATES = manifest.get("max_candidates",
+                                              cfg.MAX_CANDIDATES)
+            cfg.TABLES_DTYPE = self.dims.tables_dtype
+            cfg.EMBEDDING_OPTIMIZER = manifest.get(
+                "embedding_optimizer", cfg.EMBEDDING_OPTIMIZER)
+            self.vocabs = ckpt.load_vocabs(cfg.load_path)
+        else:
+            assert cfg.train_data_path, "varmisuse needs --data or --load"
+            self.vocabs = build_vm_vocabs(self._vm_path("train"),
+                                          cfg.MAX_TOKEN_VOCAB_SIZE,
+                                          cfg.MAX_PATH_VOCAB_SIZE)
+            self.dims = ModelDims(
+                token_vocab_size=self.vocabs.token_vocab.size,
+                path_vocab_size=self.vocabs.path_vocab.size,
+                target_vocab_size=self.vocabs.target_vocab.size,
+                embeddings_size=cfg.DEFAULT_EMBEDDINGS_SIZE,
+                max_contexts=cfg.MAX_CONTEXTS,
+                dropout_keep_rate=cfg.DROPOUT_KEEP_RATE,
+                vocab_pad_multiple=model_axis,
+                tables_dtype=cfg.TABLES_DTYPE,
+            )
+        self.optimizer = make_optimizer(cfg.LEARNING_RATE,
+                                        cfg.EMBEDDING_OPTIMIZER)
+        self.rng = jax.random.PRNGKey(cfg.SEED)
+        self.rng, init_rng = jax.random.split(self.rng)
+        params = init_vm_params(init_rng, self.dims)
+        opt_state = self.optimizer.init(params)
+        self.step_num = 0
+        if cfg.is_loading:
+            full = ckpt.load_checkpoint(
+                cfg.load_path,
+                {"params": params, "opt_state": opt_state, "step": 0})
+            params, opt_state = full["params"], full["opt_state"]
+            self.step_num = int(full.get("step", 0))
+        if self.mesh is not None:
+            params = shard_params(self.mesh, params)
+            opt_state = shard_opt_state(self.mesh, opt_state, params)
+        self.params, self.opt_state = params, opt_state
+
+        self._train_step = make_vm_train_step(
+            self.dims, self.optimizer, compute_dtype=self.compute_dtype,
+            use_pallas=self.use_pallas)
+        self._eval_step = make_vm_eval_step(
+            self.dims, compute_dtype=self.compute_dtype,
+            use_pallas=self.use_pallas)
+
+    def _vm_path(self, split: str) -> str:
+        p = self.config.train_data_path
+        assert p
+        return f"{p}.{split}.vm.c2v"
+
+    def _device_batch(self, b, process_local: bool = True):
+        weights = np.zeros((b.label.shape[0],), np.float32)
+        weights[:b.num_valid_examples] = 1.0
+        weights *= b.row_valid   # drop rows whose label was truncated
+        arrays = (b.label, b.path_source_token_indices, b.path_indices,
+                  b.path_target_token_indices, b.context_valid_mask,
+                  b.cand_ids, b.cand_mask, weights)
+        if self.mesh is not None:
+            return shard_batch(self.mesh, arrays,
+                               process_local=process_local)
+        return arrays
+
+    def train(self) -> None:
+        cfg = self.config
+        reader = VMTextReader(
+            self._vm_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
+            cfg.MAX_CANDIDATES, cfg.TRAIN_BATCH_SIZE, shuffle=True,
+            seed=cfg.SEED, host_shard=jax.process_index(),
+            num_host_shards=jax.process_count())
+        self.log(f"varmisuse training: dims={self.dims}, "
+                 f"max_candidates={cfg.MAX_CANDIDATES}")
+        window, t0 = 0, time.time()
+        for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
+            for batch in reader:
+                dev_batch = self._device_batch(batch)
+                self.rng, k = jax.random.split(self.rng)
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.opt_state, dev_batch, k)
+                self.step_num += 1
+                window += batch.num_valid_examples
+                if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
+                    dt = time.time() - t0
+                    self.log(f"vm epoch {epoch} step {self.step_num}: "
+                             f"loss {float(loss):.4f}, "
+                             f"{window / max(dt, 1e-9):.1f} ex/s")
+                    window, t0 = 0, time.time()
+            if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                self.save()
+            if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                self.log(f"vm epoch {epoch}: {self.evaluate()}")
+        self.log("varmisuse training done")
+
+    def evaluate(self, split_path: Optional[str] = None) -> VMEvalResults:
+        cfg = self.config
+        path = split_path or cfg.test_data_path
+        assert path, "evaluate requires --test"
+        reader = VMTextReader(path, self.vocabs, cfg.MAX_CONTEXTS,
+                              cfg.MAX_CANDIDATES, cfg.TEST_BATCH_SIZE)
+        loss_sum = correct = total = 0.0
+        for batch in reader:
+            dev_batch = self._device_batch(batch, process_local=False)
+            ls, cs, _pred = self._eval_step(self.params, dev_batch)
+            loss_sum += float(ls)
+            correct += float(cs)
+            total += batch.num_valid_examples
+        total = max(total, 1.0)
+        return VMEvalResults(loss_sum / total, correct / total,
+                             int(total))
+
+    def predict_batch(self, rows) -> np.ndarray:
+        """Pointer predictions (candidate indices) for `.vm.c2v` rows."""
+        from code2vec_tpu.data.vm_reader import parse_vm_rows
+
+        cfg = self.config
+        (labels, src, pth, dst, mask, cand, cand_mask, row_valid,
+         _strings) = parse_vm_rows(list(rows), self.vocabs,
+                                   cfg.MAX_CONTEXTS, cfg.MAX_CANDIDATES)
+        n = labels.shape[0]
+        weights = row_valid.copy()
+        batch = [labels, src, pth, dst, mask, cand, cand_mask, weights]
+        if self.mesh is not None:
+            # pad the batch dim to divide the data axis
+            dax = self.mesh.shape[DATA_AXIS]
+            padded = -(-n // dax) * dax
+            if padded != n:
+                for i, a in enumerate(batch):
+                    pad = np.zeros((padded - n,) + a.shape[1:], a.dtype)
+                    batch[i] = np.concatenate([a, pad], axis=0)
+                batch[6][n:, 0] = 1.0  # keep softmax finite on pad rows
+            batch = shard_batch(self.mesh, tuple(batch),
+                                process_local=False)
+        _ls, _cs, pred = self._eval_step(self.params, tuple(batch))
+        return fetch_global(pred)[:n]
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.config.save_path
+        assert path
+        state = {"params": self.params, "opt_state": self.opt_state,
+                 "step": self.step_num}
+        extra = {"head": "varmisuse",
+                 "max_candidates": self.config.MAX_CANDIDATES,
+                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER}
+        ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
+                             self.dims, extra_manifest=extra,
+                             max_to_keep=self.config.MAX_TO_KEEP)
+        self.log(f"saved varmisuse checkpoint step {self.step_num} "
+                 f"-> {path}")
+
+    def close_session(self) -> None:
+        pass

@@ -31,6 +31,7 @@ def param_pspecs() -> Dict[str, P]:
         "target_emb": P(MODEL_AXIS, None),
         "transform": P(None, None),
         "attention": P(None),
+        "vm_pointer": P(None, None),   # VarMisuse head (tiny: replicated)
     }
 
 

@@ -59,6 +59,7 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
         "max_contexts": dims.max_contexts,
         "dropout_keep_rate": dims.dropout_keep_rate,
         "vocab_pad_multiple": dims.vocab_pad_multiple,
+        "tables_dtype": dims.tables_dtype,
         "step": step,
     }
     if extra_manifest:
@@ -93,6 +94,7 @@ def load_dims(ckpt_dir: str) -> ModelDims:
         max_contexts=m["max_contexts"],
         dropout_keep_rate=m["dropout_keep_rate"],
         vocab_pad_multiple=m.get("vocab_pad_multiple", 1),
+        tables_dtype=m.get("tables_dtype", "float32"),
     )
 
 

@@ -1,0 +1,44 @@
+"""Optimizer construction.
+
+The reference trains everything with Adam (SURVEY.md §3,
+`tensorflow_model.py` training graph). On TPU the dominant step cost at
+java-large scale is the optimizer's full-table HBM traffic (measured
+15.6 ms of a 40 ms step for f32 Adam on v5e-lite; BASELINE.md), so the
+framework also offers a factored second-moment optimizer for the three
+vocab tables:
+
+- "adam": optax.adam on every param — reference-parity default.
+- "adafactor": Adafactor (factored v, no momentum) on the vocab tables,
+  Adam on TRANSFORM/ATTENTION. Cuts optimizer state for a [V, E] table
+  from 2*V*E to ~V+E and the update traffic accordingly — the standard
+  large-embedding practice.
+"""
+
+from __future__ import annotations
+
+import optax
+
+TABLE_PARAMS = ("token_emb", "path_emb", "target_emb")
+
+
+def make_optimizer(learning_rate: float,
+                   embedding_optimizer: str = "adam"
+                   ) -> optax.GradientTransformation:
+    if embedding_optimizer == "adam":
+        return optax.adam(learning_rate)
+    if embedding_optimizer == "adafactor":
+        # label by key so extra head params (e.g. vm_pointer) route to
+        # adam automatically
+        def labels(params):
+            return {k: ("table" if k in TABLE_PARAMS else "small")
+                    for k in params}
+
+        return optax.multi_transform(
+            {"table": optax.adafactor(
+                learning_rate, multiply_by_parameter_scale=False,
+                momentum=None),
+             "small": optax.adam(learning_rate)},
+            labels)
+    raise ValueError(
+        f"unknown embedding_optimizer {embedding_optimizer!r} "
+        "(expected 'adam' or 'adafactor')")

@@ -1,0 +1,60 @@
+"""Jitted train/eval steps for the VarMisuse head (models/varmisuse.py).
+
+Same shape discipline as training/steps.py: static shapes, pure
+functions, sharding carried by the inputs, donation on the hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.models.varmisuse import vm_loss, vm_scores
+
+
+def make_vm_train_step(dims: ModelDims,
+                       optimizer: optax.GradientTransformation, *,
+                       compute_dtype=jnp.float32,
+                       use_pallas: bool = False) -> Callable:
+    """step(params, opt_state, batch, rng) -> (params, opt_state, loss);
+    batch = (labels, src, pth, dst, mask, cand_ids, cand_mask,
+    weights)."""
+
+    def loss_fn(params, batch, rng):
+        return vm_loss(params, batch, dropout_rng=rng,
+                       dropout_keep_rate=dims.dropout_keep_rate,
+                       compute_dtype=compute_dtype, use_pallas=use_pallas)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_vm_eval_step(dims: ModelDims, *, compute_dtype=jnp.float32,
+                      use_pallas: bool = False) -> Callable:
+    """step(params, batch) -> (loss_sum, correct_sum, pred [B]);
+    no dropout."""
+
+    @jax.jit
+    def step(params, batch):
+        labels, src, pth, dst, mask, cand_ids, cand_mask, weights = batch
+        scores, _ = vm_scores(params, src, pth, dst, mask, cand_ids,
+                              cand_mask, compute_dtype=compute_dtype,
+                              use_pallas=use_pallas)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        pred = jnp.argmax(scores, axis=-1)
+        correct = (pred == labels).astype(jnp.float32)
+        return (jnp.sum(ce * weights), jnp.sum(correct * weights), pred)
+
+    return step

@@ -1,0 +1,97 @@
+"""bf16 vocab tables + adafactor embedding optimizer (the measured perf
+configuration, BASELINE.md): training still learns, checkpoints
+round-trip preserving the storage dtype, and the dtype/optimizer pair is
+recorded in the manifest so --load reconstructs the right model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models.encoder import ModelDims, init_params
+from code2vec_tpu.models.jax_model import Code2VecModel
+from tests.helpers import build_tiny_dataset
+from tests.test_model import tiny_config
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    return build_tiny_dataset(str(d), n_train=256, n_val=32, n_test=64,
+                              max_contexts=16)
+
+
+def test_init_params_tables_dtype():
+    dims = ModelDims(token_vocab_size=16, path_vocab_size=16,
+                     target_vocab_size=8, embeddings_size=4,
+                     max_contexts=4, tables_dtype="bfloat16")
+    import jax
+    p = init_params(jax.random.PRNGKey(0), dims)
+    assert p["token_emb"].dtype == jnp.bfloat16
+    assert p["target_emb"].dtype == jnp.bfloat16
+    # numerics-sensitive small params stay f32
+    assert p["transform"].dtype == jnp.float32
+    assert p["attention"].dtype == jnp.float32
+
+
+def test_bf16_adafactor_trains_and_roundtrips(dataset, tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = tiny_config(dataset, TABLES_DTYPE="bfloat16",
+                      EMBEDDING_OPTIMIZER="adafactor",
+                      NUM_TRAIN_EPOCHS=6)
+    cfg.save_path = ckpt_dir
+    model = Code2VecModel(cfg)
+    assert model.params["token_emb"].dtype == jnp.bfloat16
+    before = model.evaluate()
+    model.train()
+    after = model.evaluate()
+    assert after.loss < before.loss
+    assert after.subtoken_f1 > 0.5
+    model.save(ckpt_dir)
+
+    cfg2 = tiny_config(dataset)  # dtype/optimizer come from the manifest
+    cfg2.load_path = ckpt_dir
+    model2 = Code2VecModel(cfg2)
+    assert model2.params["token_emb"].dtype == jnp.bfloat16
+    assert model2.config.EMBEDDING_OPTIMIZER == "adafactor"
+    loaded = model2.evaluate()
+    assert loaded.topk_acc == pytest.approx(after.topk_acc)
+
+
+def test_sparse_updates_reject_lowp_config(dataset):
+    cfg = tiny_config(dataset, SPARSE_EMBEDDING_UPDATES=True,
+                      TABLES_DTYPE="bfloat16")
+    with pytest.raises(ValueError):
+        cfg.verify()
+
+
+def test_bf16_numerics_close_to_f32_one_step(dataset):
+    """One train step with bf16 tables stays close to the f32 step —
+    the rounding shows up in the 3rd significant digit, not the 1st."""
+    import jax
+    import optax
+
+    from code2vec_tpu.training.steps import make_train_step
+    from tests.helpers import example_batch
+
+    dims32 = ModelDims(token_vocab_size=64, path_vocab_size=48,
+                       target_vocab_size=40, embeddings_size=16,
+                       max_contexts=8, dropout_keep_rate=1.0)
+    dims16 = ModelDims(token_vocab_size=64, path_vocab_size=48,
+                       target_vocab_size=40, embeddings_size=16,
+                       max_contexts=8, dropout_keep_rate=1.0,
+                       tables_dtype="bfloat16")
+    p32 = init_params(jax.random.PRNGKey(0), dims32)
+    # the train step donates params, so both runs need their own copies
+    p16 = {k: (v.astype(jnp.bfloat16)
+               if k in ("token_emb", "path_emb", "target_emb")
+               else jnp.copy(v))
+           for k, v in p32.items()}
+    batch = example_batch(seed=5, dims=dims32, batch=16)
+    opt = optax.adam(1e-2)
+    rng = jax.random.PRNGKey(3)
+    s32 = make_train_step(dims32, opt)
+    s16 = make_train_step(dims16, opt)
+    _, _, l32 = s32(p32, opt.init(p32), batch, rng)
+    _, _, l16 = s16(p16, opt.init(p16), batch, rng)
+    np.testing.assert_allclose(float(l32), float(l16), rtol=2e-2)

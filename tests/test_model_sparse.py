@@ -22,7 +22,8 @@ def _cfg(prefix, **kw):
                  TEST_BATCH_SIZE=32, NUM_TRAIN_EPOCHS=6,
                  SAVE_EVERY_EPOCHS=100, NUM_BATCHES_TO_LOG_PROGRESS=1000,
                  LEARNING_RATE=0.05, USE_BF16=False,
-                 SPARSE_EMBEDDING_UPDATES=True)
+                 SPARSE_EMBEDDING_UPDATES=True,
+                 TABLES_DTYPE="float32")  # sparse path is f32-only
     cfg.train_data_path = prefix
     cfg.test_data_path = prefix + ".test.c2v"
     for k, v in kw.items():

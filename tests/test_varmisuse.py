@@ -1,0 +1,161 @@
+"""VarMisuse head (BASELINE.json configs[3]): generator row validity,
+reader shapes, above-chance bug localization after a short train, and
+checkpoint round-trip via the --head varmisuse model class."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.varmisuse_gen import (SLOT_TOKEN, make_vm_rows,
+                                             make_vm_source,
+                                             write_vm_dataset)
+from code2vec_tpu.extractor import native
+
+
+def _need_native():
+    if not native.available():
+        pytest.skip("native extractor not built")
+
+
+def vm_config(prefix, **kw):
+    cfg = Config(
+        MAX_CONTEXTS=64,
+        MAX_TOKEN_VOCAB_SIZE=1000,
+        MAX_PATH_VOCAB_SIZE=2000,
+        MAX_TARGET_VOCAB_SIZE=10,
+        DEFAULT_EMBEDDINGS_SIZE=32,
+        TRAIN_BATCH_SIZE=32,
+        TEST_BATCH_SIZE=32,
+        NUM_TRAIN_EPOCHS=8,
+        SAVE_EVERY_EPOCHS=100,
+        NUM_BATCHES_TO_LOG_PROGRESS=1000,
+        LEARNING_RATE=0.02,
+        USE_BF16=False,
+        MESH_MODEL_AXIS=1,
+        HEAD="varmisuse",
+        MAX_CANDIDATES=6,
+    )
+    cfg.train_data_path = prefix
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_vm_source_has_exactly_one_hole():
+    import re
+
+    keywords = {"class", "int", "boolean", "void", "for", "if",
+                "return", "this", "VM", SLOT_TOKEN}
+    rng = random.Random(3)
+    for _ in range(200):
+        src, cands, label = make_vm_source(rng)
+        assert src.count(SLOT_TOKEN) == 1
+        assert 0 <= label < len(cands)
+        assert len(set(cands)) == len(cands)
+        # the hole replaced a USE of the labeled var: the var still
+        # appears elsewhere (declaration at minimum)
+        assert re.search(rf"\b{cands[label]}\b",
+                         src.replace(SLOT_TOKEN, " "))
+        # no corrupted identifiers: every identifier in the source is a
+        # keyword, a method name, or one of the declared variables
+        # (catches substring-boundary bugs in hole insertion)
+        for ident in re.findall(r"[A-Za-z_]\w*", src):
+            assert (ident in keywords or ident in cands
+                    or ident.startswith("method")), (ident, src)
+
+
+def test_vm_rows_parse_and_carry_slot():
+    _need_native()
+    rows = make_vm_rows(20, seed=5)
+    assert len(rows) == 20
+    for row in rows:
+        parts = row.split(" ")
+        label = int(parts[0])
+        cands = parts[1].split(",")
+        assert 0 <= label < len(cands)
+        assert any(SLOT_TOKEN in ctx for ctx in parts[2:])
+        for ctx in parts[2:]:
+            assert len(ctx.split(",")) == 3
+
+
+def test_vm_reader_shapes(tmp_path):
+    _need_native()
+    from code2vec_tpu.data.vm_reader import (VMTextReader,
+                                             build_vm_vocabs)
+
+    prefix = str(tmp_path / "vm")
+    write_vm_dataset(prefix, n_train=40, n_val=8, n_test=8, seed=1)
+    vocabs = build_vm_vocabs(prefix + ".train.vm.c2v", 1000, 2000)
+    assert vocabs.token_vocab.lookup_index(SLOT_TOKEN) \
+        != vocabs.token_vocab.oov_index
+
+    reader = VMTextReader(prefix + ".train.vm.c2v", vocabs,
+                          max_contexts=64, max_candidates=6,
+                          batch_size=16)
+    batches = list(reader)
+    assert sum(b.num_valid_examples for b in batches) == 40
+    b = batches[0]
+    assert b.label.shape == (16,)
+    assert b.cand_ids.shape == (16, 6)
+    assert b.path_indices.shape == (16, 64)
+    assert b.cand_mask[0].sum() == 5  # 5 role candidates per example
+    assert b.context_valid_mask.max() == 1.0
+    # final padded batch keeps one live candidate per padded row
+    last = batches[-1]
+    assert last.cand_mask.min(axis=1).max() <= 1.0
+    assert last.cand_mask.sum(axis=1).min() >= 1.0
+
+
+@pytest.fixture(scope="module")
+def vm_dataset(tmp_path_factory):
+    _need_native()
+    d = tmp_path_factory.mktemp("vm")
+    prefix = os.path.join(str(d), "vm")
+    write_vm_dataset(prefix, n_train=1200, n_val=150, n_test=100,
+                     seed=11)
+    return prefix
+
+
+def test_vm_training_beats_chance_and_roundtrips(vm_dataset, tmp_path):
+    from code2vec_tpu.models.vm_model import VarMisuseModel
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = vm_config(vm_dataset, save_path=ckpt_dir)
+    cfg.test_data_path = vm_dataset + ".val.vm.c2v"
+    model = VarMisuseModel(cfg)
+    before = model.evaluate()
+    model.train()
+    after = model.evaluate()
+    assert after.loss < before.loss
+    # 5 live candidates -> chance = 0.2; role-consistent synthetic data
+    # is fully learnable (measured 0.8-1.0 at these settings across
+    # tables dtypes)
+    assert after.accuracy >= 0.7, after
+    model.save(ckpt_dir)
+
+    cfg2 = vm_config(vm_dataset)
+    cfg2.train_data_path = None
+    cfg2.load_path = ckpt_dir
+    cfg2.test_data_path = vm_dataset + ".val.vm.c2v"
+    model2 = VarMisuseModel(cfg2)
+    assert model2.step_num == model.step_num
+    loaded = model2.evaluate()
+    assert loaded.accuracy == pytest.approx(after.accuracy)
+
+    # pointer predictions on fresh rows the model never saw
+    rows = make_vm_rows(25, seed=99)
+    pred = model2.predict_batch(rows)
+    assert pred.shape == (25,)
+    labels = [int(r.split(" ")[0]) for r in rows]
+    acc = np.mean([p == l for p, l in zip(pred, labels)])
+    assert acc >= 0.5  # far above the 0.2 chance level
+
+
+def test_vm_cli_flag_validation(vm_dataset):
+    cfg = vm_config(vm_dataset, is_predict=True)
+    cfg.load_path = "whatever"
+    with pytest.raises(ValueError):
+        cfg.verify()

@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Generate a realistic synthetic Java corpus for the quality study.
+
+BASELINE.md quality-evidence requirement (SURVEY.md §8.4 item 3): the
+sampled-softmax / low-precision ablations need a corpus with a ≥50K-name
+target vocabulary and realistic skew — the 8-class test fixture can't
+show an F1 gap. This generator writes Java classes whose method names
+are verb+adjective+noun subtoken compositions (Zipf-weighted, so name
+frequencies look like real code) and whose bodies reference identifiers
+correlated with the name — the actual signal code2vec learns. The
+corpus goes through the NATIVE C++ extractor like any real dataset.
+
+Usage:
+  python tools/gen_java_corpus.py --out /tmp/qs/raw --names 50000 \
+      --methods 250000 [--seed 7]
+creates <out>/{train,val,test}/*.java
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+VERBS = ["get", "set", "is", "has", "compute", "find", "make", "build",
+         "read", "write", "add", "remove", "update", "create", "delete",
+         "load", "store", "parse", "format", "init", "reset", "clear",
+         "count", "sum", "merge", "split", "copy", "move", "sort",
+         "filter", "map", "apply", "check", "validate", "convert",
+         "encode", "decode", "open", "close", "flush"]
+ADJS = ["", "max", "min", "total", "last", "first", "next", "prev",
+        "old", "new", "raw", "base", "temp", "local", "global", "cached",
+        "active", "pending", "valid", "dirty", "sorted", "unique",
+        "shared", "remote", "inner", "outer", "upper", "lower", "left",
+        "right", "partial", "full", "empty", "default", "current",
+        "initial", "final2", "safe", "fast", "slow"]
+NOUNS = ["value", "name", "index", "count", "item", "node", "list",
+         "map2", "key", "entry", "buffer", "stream", "file", "path",
+         "user", "account", "session", "token", "request", "response",
+         "message", "event", "handler", "state", "config", "option",
+         "result", "error", "status", "code", "line", "column", "row",
+         "cell", "table", "record", "field", "type", "size", "length",
+         "width", "height", "offset", "position", "range", "limit",
+         "total", "amount", "price", "rate", "score", "weight", "level",
+         "depth", "degree", "angle", "point", "vector", "matrix",
+         "color", "image", "pixel", "frame", "page", "block", "chunk",
+         "segment", "region", "zone", "area", "bounds", "margin",
+         "border", "padding", "label", "title", "text", "word", "char2",
+         "digit", "number", "flag", "mask", "bit", "byte2", "hash",
+         "checksum", "id2", "uuid", "version", "revision", "timestamp",
+         "date", "time", "duration", "interval", "delay", "timeout",
+         "retry", "attempt", "batch", "queue", "stack", "heap", "tree",
+         "graph", "edge", "vertex", "parent", "child", "sibling",
+         "root", "leaf", "branch", "head", "tail", "cursor", "iterator"]
+
+
+def cap(s: str) -> str:
+    return s[:1].upper() + s[1:] if s else s
+
+
+def method_source(rng: random.Random, verb: str, adj: str,
+                  noun: str) -> str:
+    """A method whose body references identifiers correlated with the
+    name (the signal), plus random distractor statements (the noise)."""
+    field = (adj + cap(noun)) if adj else noun
+    mname = verb + cap(adj) + cap(noun) if adj else verb + cap(noun)
+    distract = rng.choice(NOUNS)
+    d2 = rng.choice(NOUNS)
+    lines = []
+    if verb in ("get", "read", "load"):
+        lines = [f"int {mname}() {{",
+                 f"  return {field};", "}"]
+    elif verb in ("set", "write", "store", "update"):
+        lines = [f"void {mname}(int {field}) {{",
+                 f"  this.{field} = {field};", "}"]
+    elif verb in ("is", "has", "check", "validate"):
+        lines = [f"boolean {mname}() {{",
+                 f"  return {field} > 0;", "}"]
+    elif verb in ("count", "sum"):
+        lines = [f"int {mname}(int[] items) {{",
+                 "  int total = 0;",
+                 "  for (int i = 0; i < items.length; i++) {",
+                 f"    total += items[i] * {field};", "  }",
+                 "  return total;", "}"]
+    elif verb in ("find",):
+        lines = [f"int {mname}(int[] items) {{",
+                 "  for (int i = 0; i < items.length; i++) {",
+                 f"    if (items[i] == {field}) {{ return i; }}", "  }",
+                 "  return -1;", "}"]
+    elif verb in ("add", "merge"):
+        lines = [f"int {mname}(int other) {{",
+                 f"  {field} = {field} + other;",
+                 f"  return {field};", "}"]
+    elif verb in ("remove", "delete", "clear", "reset"):
+        lines = [f"void {mname}() {{",
+                 f"  {field} = 0;",
+                 f"  int {distract} = 0;", "}"]
+    else:
+        lines = [f"int {mname}(int x) {{",
+                 f"  int {field} = x * 2 + {d2};",
+                 f"  if ({field} > x) {{ {field} -= 1; }}",
+                 f"  return {field};", "}"]
+    if rng.random() < 0.3:
+        lines.insert(-1, f"  int {distract} = {d2} + 1;")
+    return "\n".join("  " + ln for ln in lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--names", type=int, default=50_000)
+    ap.add_argument("--methods", type=int, default=250_000)
+    ap.add_argument("--methods_per_class", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+
+    # build the name universe and give it a Zipf weighting
+    combos = [(v, a, n) for v in VERBS for a in ADJS for n in NOUNS]
+    rng.shuffle(combos)
+    names = combos[:args.names]
+    weights = [1.0 / (r + 10) for r in range(len(names))]  # Zipf-ish
+
+    splits = (("train", 0.8), ("val", 0.1), ("test", 0.1))
+    total_written = 0
+    for split, frac in splits:
+        n_methods = int(args.methods * frac)
+        d = os.path.join(args.out, split)
+        os.makedirs(d, exist_ok=True)
+        # train: guarantee every name appears >=2 times (so the full
+        # target vocab exists and is learnable), then fill the rest with
+        # the Zipf draw; val/test: natural Zipf draw only.
+        pool = []
+        if split == "train":
+            pool = [nm for nm in names for _ in range(2)]
+            rng.shuffle(pool)
+            pool = pool[:n_methods]
+        pool += rng.choices(names, weights=weights,
+                            k=n_methods - len(pool))
+        rng.shuffle(pool)
+        file_idx = 0
+        written = 0
+        while written < n_methods:
+            k = min(args.methods_per_class, n_methods - written)
+            chosen = pool[written:written + k]
+            body = []
+            fields = set()
+            for v, a, n in chosen:
+                fields.add((a + cap(n)) if a else n)
+                body.append(method_source(rng, v, a, n))
+            field_decls = "\n".join(f"  int {f};" for f in sorted(fields))
+            cls = (f"class C{split.capitalize()}{file_idx} {{\n"
+                   f"{field_decls}\n" + "\n".join(body) + "\n}\n")
+            with open(os.path.join(d, f"C{file_idx}.java"), "w") as f:
+                f.write(cls)
+            file_idx += 1
+            written += k
+        total_written += written
+        print(f"{split}: {written} methods in {file_idx} files")
+    print(f"total: {total_written} methods, "
+          f"{len(names)} distinct target names")
+
+
+if __name__ == "__main__":
+    main()

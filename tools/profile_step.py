@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Phase-level profile of the java-large training step on the local chip.
+
+Times, via the donated-chain + host-transfer sync that is reliable on the
+tunneled axon platform (see bench.py), each of:
+
+  - HBM streaming bandwidth (copy of a ~1 GB buffer) — the ceiling
+  - forward only (encode + sampled softmax loss)
+  - forward + backward (grads materialized)
+  - full step (fwd + bwd + Adam), per optimizer variant
+
+Usage: python tools/profile_step.py [--batch 1024] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TOKEN_VOCAB = 1_301_136
+PATH_VOCAB = 911_417
+TARGET_VOCAB = 261_245
+CTX = 200
+NUM_SAMPLED = 4096
+
+
+def timeit(fn, sync, steps, warmup=3):
+    for _ in range(warmup):
+        out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+    B = args.batch
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from code2vec_tpu.models.encoder import ModelDims, encode, init_params
+    from code2vec_tpu.ops.sampled_softmax import sampled_softmax_loss
+    from code2vec_tpu.training.steps import make_train_step
+
+    dims = ModelDims(token_vocab_size=TOKEN_VOCAB,
+                     path_vocab_size=PATH_VOCAB,
+                     target_vocab_size=TARGET_VOCAB,
+                     embeddings_size=128, max_contexts=CTX)
+    params = init_params(jax.random.PRNGKey(0), dims)
+
+    r = np.random.default_rng(0)
+    labels = jnp.asarray(r.integers(0, TARGET_VOCAB, (B,), dtype=np.int32))
+    src = jnp.asarray(r.integers(0, TOKEN_VOCAB, (B, CTX), dtype=np.int32))
+    pth = jnp.asarray(r.integers(0, PATH_VOCAB, (B, CTX), dtype=np.int32))
+    dst = jnp.asarray(r.integers(0, TOKEN_VOCAB, (B, CTX), dtype=np.int32))
+    mask = jnp.ones((B, CTX), jnp.float32)
+    weights = jnp.ones((B,), jnp.float32)
+    batch = (labels, src, pth, dst, mask, weights)
+    rng = jax.random.PRNGKey(1)
+
+    # ---- HBM streaming ceiling ----
+    big = jnp.zeros((256 * 1024 * 1024 // 4,), jnp.float32)  # 1 GiB
+
+    @jax.jit
+    def copy(x):
+        return x * 1.0000001
+
+    dt = timeit(lambda: copy(big), lambda o: float(o[0]), 8)
+    bw = 2 * big.size * 4 / dt
+    print(f"HBM streaming (1 GiB copy): {dt*1e3:.2f} ms "
+          f"-> {bw/1e9:.0f} GB/s effective")
+
+    # ---- forward only ----
+    def loss_fn(params, rng):
+        code, _ = encode(params, src, pth, dst, mask,
+                         compute_dtype=jnp.bfloat16)
+        loss, _ = sampled_softmax_loss(
+            params["target_emb"], code, labels, rng, NUM_SAMPLED,
+            example_weights=weights, vocab_size=TARGET_VOCAB)
+        return loss
+
+    fwd = jax.jit(loss_fn)
+    dt = timeit(lambda: fwd(params, rng), lambda o: float(o), args.steps)
+    print(f"forward only:        {dt*1e3:6.2f} ms")
+
+    # ---- forward + backward ----
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    dt = timeit(lambda: grad_fn(params, rng), lambda o: float(o[0]),
+                args.steps)
+    print(f"forward + backward:  {dt*1e3:6.2f} ms")
+
+    # ---- full step, dense Adam ----
+    def run_full(label, step, opt_state0):
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        s = opt_state0
+        k = jax.random.PRNGKey(2)
+        nonlocal_state = {"p": p, "s": s, "k": k}
+
+        def one():
+            st = nonlocal_state
+            st["k"], sub = jax.random.split(st["k"])
+            st["p"], st["s"], loss = step(st["p"], st["s"], batch, sub)
+            return loss
+
+        dt = timeit(one, lambda o: float(o), args.steps)
+        pc = B * CTX / dt
+        print(f"{label}: {dt*1e3:6.2f} ms -> {pc/1e6:.2f}M pc/s")
+        return dt
+
+    opt = optax.adam(1e-3)
+    step = make_train_step(dims, opt, use_sampled_softmax=True,
+                           num_sampled=NUM_SAMPLED,
+                           compute_dtype=jnp.bfloat16,
+                           use_pallas=jax.default_backend() == "tpu")
+    run_full("full step (dense Adam, f32 moments)", step, opt.init(params))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Quality ablation: sampled softmax & low-precision vs the exact config.
+
+BASELINE.md quality-evidence requirement (SURVEY.md §8.4 item 3): show on
+a ≥50K-name corpus (tools/gen_java_corpus.py, extracted by the native
+C++ extractor) that
+  - sampled softmax matches full softmax F1 (the java-large config), and
+  - bf16 tables / the adafactor table optimizer (the perf configs,
+    BASELINE.md) match f32/adam F1
+at matched steps, seeds, and data order.
+
+Usage:
+  python tools/gen_java_corpus.py --out /tmp/qs/raw ...
+  TRAIN_DIR=... ./preprocess.sh   (see BASELINE.md)
+  python tools/quality_study.py --data /tmp/qs/ds/qs --epochs 6 \
+      [--variants full-f32-adam,sampled-f32-adam,...]
+Prints one JSON line per variant and a summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+VARIANTS = {
+    # name: (use_sampled, tables_dtype, embedding_optimizer)
+    "full-f32-adam": (False, "float32", "adam"),
+    "sampled-f32-adam": (True, "float32", "adam"),
+    "sampled-bf16-adam": (True, "bfloat16", "adam"),
+    "sampled-bf16-adafactor": (True, "bfloat16", "adafactor"),
+}
+
+
+def run_variant(name: str, data: str, epochs: int, batch: int,
+                num_sampled: int, seed: int) -> dict:
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.models.jax_model import Code2VecModel
+
+    use_sampled, tdtype, eopt = VARIANTS[name]
+    cfg = Config(
+        MAX_CONTEXTS=200,
+        MAX_TOKEN_VOCAB_SIZE=150_000,
+        MAX_PATH_VOCAB_SIZE=150_000,
+        MAX_TARGET_VOCAB_SIZE=60_000,
+        TRAIN_BATCH_SIZE=batch,
+        TEST_BATCH_SIZE=batch,
+        NUM_TRAIN_EPOCHS=epochs,
+        SAVE_EVERY_EPOCHS=1000,
+        NUM_BATCHES_TO_LOG_PROGRESS=100,
+        LEARNING_RATE=1e-3,
+        SEED=seed,
+        USE_SAMPLED_SOFTMAX=use_sampled,
+        NUM_SAMPLED_CLASSES=num_sampled,
+        TABLES_DTYPE=tdtype,
+        EMBEDDING_OPTIMIZER=eopt,
+    )
+    cfg.train_data_path = data
+    cfg.test_data_path = data + ".val.c2v"
+    model = Code2VecModel(cfg)
+    t0 = time.time()
+    model.train()
+    train_s = time.time() - t0
+    res = model.evaluate()
+    out = {
+        "variant": name,
+        "use_sampled_softmax": use_sampled,
+        "tables_dtype": tdtype,
+        "embedding_optimizer": eopt,
+        "epochs": epochs,
+        "steps": model.step_num,
+        "train_seconds": round(train_s, 1),
+        "val_loss": round(float(res.loss), 4),
+        "val_top1": round(res.topk_acc[0], 4),
+        "val_top5": round(res.topk_acc[4], 4),
+        "val_precision": round(res.subtoken_precision, 4),
+        "val_recall": round(res.subtoken_recall, 4),
+        "val_f1": round(res.subtoken_f1, 4),
+        "target_vocab_size": model.vocabs.target_vocab.size,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--num_sampled", type=int, default=1024)
+    ap.add_argument("--seed", type=int, default=239)
+    ap.add_argument("--variants", default=",".join(VARIANTS))
+    ap.add_argument("--out", default=None,
+                    help="append JSON lines here too")
+    args = ap.parse_args()
+
+    results = []
+    for name in args.variants.split(","):
+        r = run_variant(name.strip(), args.data, args.epochs, args.batch,
+                        args.num_sampled, args.seed)
+        results.append(r)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(json.dumps(r) + "\n")
+
+    print("\nvariant                    F1      top1    loss")
+    for r in results:
+        print(f"{r['variant']:26s} {r['val_f1']:.4f}  "
+              f"{r['val_top1']:.4f}  {r['val_loss']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Measure the REFERENCE's training-step math in TensorFlow 2.21.
+
+BASELINE.md action item 2 / SURVEY.md §7: the ≥8x north star needs a
+measured denominator, not a guess. The reference (per SURVEY.md §3,
+`tensorflow_model.Code2VecModel._build_tf_training_graph`) trains, on one
+GPU, fp32, full softmax:
+
+  3 embedding gathers -> concat [B,C,384] -> dropout(keep .75)
+  -> tanh(ctx @ TRANSFORM[384,384]) -> attention logits (@ ATTENTION[384,1])
+  + log(mask) -> softmax over C -> weighted sum = code vector [B,384]
+  -> logits = code @ TARGET_VOCAB^T [261245] -> sparse softmax CE -> Adam.
+
+This script re-implements exactly that step as a tf.function and times it
+on the host, alongside the host's practical GEMM peak, yielding the
+step's achieved-efficiency fraction. tools/v100_roofline.py converts the
+analytic step cost + standard GPU efficiency ranges into the documented
+V100 denominator (BASELINE.md "Baseline denominator" section).
+
+Usage: python tools/tf_baseline.py [--batch 256] [--steps 3] [--full]
+  --full uses the java-large capacities (slow on small hosts); default
+  uses reduced vocab capacities, which leaves the per-example FLOPs of
+  the dominant terms unchanged except the target-vocab logits matmul,
+  reported separately.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+# java-large capacities (SURVEY.md §3 config row)
+TOKEN_VOCAB = 1_301_136
+PATH_VOCAB = 911_417
+TARGET_VOCAB = 261_245
+EMB = 128
+CTX = 200
+
+
+def step_flops(batch: int, target_vocab: int) -> float:
+    """Analytic fwd+bwd FLOPs of the reference step (matmul terms; the
+    gathers/elementwise are bandwidth, not FLOPs)."""
+    d = 3 * EMB
+    transform = 2.0 * batch * CTX * d * d          # [B*C,384]@[384,384]
+    attention = 2.0 * batch * CTX * d              # [B*C,384]@[384,1]
+    logits = 2.0 * batch * d * target_vocab        # [B,384]@[384,V]
+    fwd = transform + attention + logits
+    return 3.0 * fwd  # bwd ~ 2x fwd for matmul chains
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--full", action="store_true",
+                    help="full java-large vocab capacities")
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+
+    if args.full:
+        vt, vp, vtar = TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB
+    else:
+        # Reduced tables: embedding-gather traffic per example is
+        # unchanged (gather cost ~ rows touched, not table size); only
+        # the logits matmul shrinks, so we report it separately.
+        vt, vp, vtar = 65_536, 65_536, 16_384
+
+    rng = np.random.default_rng(0)
+    init = tf.initializers.GlorotUniform(seed=0)
+    words = tf.Variable(init((vt, EMB)), name="WORDS_VOCAB")
+    paths = tf.Variable(init((vp, EMB)), name="PATHS_VOCAB")
+    target = tf.Variable(init((vtar, 3 * EMB)), name="TARGET_WORDS_VOCAB")
+    transform = tf.Variable(init((3 * EMB, 3 * EMB)), name="TRANSFORM")
+    attention = tf.Variable(init((3 * EMB, 1)), name="ATTENTION")
+    opt = tf.keras.optimizers.Adam(learning_rate=1e-3)
+    variables = [words, paths, target, transform, attention]
+
+    @tf.function(jit_compile=False)  # reference TF1 graph, no XLA
+    def train_step(src, pth, dst, mask, labels):
+        with tf.GradientTape() as tape:
+            e = tf.concat([tf.nn.embedding_lookup(words, src),
+                           tf.nn.embedding_lookup(paths, pth),
+                           tf.nn.embedding_lookup(words, dst)], axis=-1)
+            e = tf.nn.dropout(e, rate=0.25)
+            flat = tf.reshape(e, [-1, 3 * EMB])
+            ctx = tf.math.tanh(tf.matmul(flat, transform))
+            attn_logits = tf.reshape(tf.matmul(ctx, attention),
+                                     [-1, CTX]) + tf.math.log(mask)
+            attn = tf.nn.softmax(attn_logits, axis=-1)
+            code = tf.einsum("bc,bcd->bd", attn,
+                             tf.reshape(ctx, [-1, CTX, 3 * EMB]))
+            logits = tf.matmul(code, target, transpose_b=True)
+            loss = tf.reduce_mean(
+                tf.nn.sparse_softmax_cross_entropy_with_logits(
+                    labels=labels, logits=logits))
+        grads = tape.gradient(loss, variables)
+        opt.apply_gradients(zip(grads, variables))
+        return loss
+
+    B = args.batch
+    src = tf.constant(rng.integers(0, vt, (B, CTX)), tf.int32)
+    pth = tf.constant(rng.integers(0, vp, (B, CTX)), tf.int32)
+    dst = tf.constant(rng.integers(0, vt, (B, CTX)), tf.int32)
+    mask = tf.constant(np.ones((B, CTX), np.float32))
+    labels = tf.constant(rng.integers(0, vtar, (B,)), tf.int32)
+
+    train_step(src, pth, dst, mask, labels)  # trace + warm
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = train_step(src, pth, dst, mask, labels)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    # Host practical GEMM peak (fp32), for the efficiency fraction.
+    a = tf.constant(rng.normal(size=(4096, 4096)).astype(np.float32))
+    b = tf.constant(rng.normal(size=(4096, 4096)).astype(np.float32))
+    _ = tf.matmul(a, b)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        c = tf.matmul(a, b)
+    _ = float(tf.reduce_sum(c))
+    gemm_dt = (time.perf_counter() - t0) / 3
+    gemm_flops = 2.0 * 4096**3 / gemm_dt
+
+    flops = step_flops(B, vtar)
+    achieved = flops / dt
+    out = {
+        "tf_version": __import__("tensorflow").__version__,
+        "device": "host CPU",
+        "batch": B,
+        "vocab": {"token": vt, "path": vp, "target": vtar},
+        "sec_per_step": round(dt, 4),
+        "examples_per_sec": round(B / dt, 2),
+        "path_contexts_per_sec": round(B * CTX / dt, 1),
+        "analytic_matmul_flops_per_step": flops,
+        "achieved_gflops": round(achieved / 1e9, 2),
+        "host_gemm_peak_gflops": round(gemm_flops / 1e9, 2),
+        "step_efficiency_vs_gemm_peak": round(achieved / gemm_flops, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
