@@ -53,6 +53,7 @@ class Config:
     BACKEND: str = "tpu"  # 'tpu' | 'cpu' — selects jax platform expectations
     MESH_DATA_AXIS: int = 0   # 0 → use all devices on the data axis
     MESH_MODEL_AXIS: int = 1  # model-parallel degree for sharded vocab tables
+    MESH_CONTEXT_AXIS: int = 1  # context-parallel degree (transformer)
     USE_BF16: bool = True     # compute in bfloat16 on the MXU, params f32
     # Touched-rows-only (lazy) Adam for the vocab tables. Measured on one
     # v5e chip at java-large scale: row-granular scatter/gather runs at
@@ -77,6 +78,13 @@ class Config:
     # at B=1024). Default on; it only takes effect on a TPU backend
     # (the model silently falls back to the XLA pool elsewhere).
     USE_PALLAS: bool = True
+
+    # ---- encoder architecture: "bag" (reference parity) or
+    # "transformer" (set transformer over the contexts,
+    # models/transformer_encoder.py; BASELINE.json configs[4]). ----
+    ENCODER_TYPE: str = "bag"
+    XF_LAYERS: int = 2
+    XF_HEADS: int = 4
 
     # ---- task head: "code2vec" (method-name prediction, reference
     # parity) or "varmisuse" (pointer-style variable-misuse repair,
@@ -107,6 +115,13 @@ class Config:
 
     # ---- logging ----
     LOG_PATH: Optional[str] = None
+
+    # ---- profiling (SURVEY.md §6 tracing row): --profile <dir> wraps
+    # PROFILE_STEPS training steps in jax.profiler.start_trace /
+    # stop_trace; the trace opens in tensorboard-plugin-profile. ----
+    PROFILE_DIR: Optional[str] = None
+    PROFILE_STEPS: int = 10
+    PROFILE_START_STEP: int = 5  # skip compile + warmup steps
 
     def __post_init__(self) -> None:
         if self.TARGET_EMBEDDINGS_SIZE is None:
@@ -195,6 +210,12 @@ class Config:
         p.add_argument("--sampled_softmax", dest="sampled_softmax",
                        action="store_true")
         p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
+        p.add_argument("--encoder", dest="encoder", default=None,
+                       choices=["bag", "transformer"])
+        p.add_argument("--xf_layers", dest="xf_layers", type=int,
+                       default=None)
+        p.add_argument("--xf_heads", dest="xf_heads", type=int,
+                       default=None)
         p.add_argument("--head", dest="head", default=None,
                        choices=["code2vec", "varmisuse"])
         p.add_argument("--max_candidates", dest="max_candidates",
@@ -205,6 +226,8 @@ class Config:
                        default=None, choices=["adam", "adafactor"])
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
+        p.add_argument("--mesh_context", dest="mesh_context", type=int,
+                       default=None)
         p.add_argument("--seed", dest="seed", type=int, default=None)
         p.add_argument("--dist_coordinator", dest="dist_coordinator",
                        default=None,
@@ -214,6 +237,11 @@ class Config:
         p.add_argument("--dist_process_id", dest="dist_process_id",
                        type=int, default=None)
         p.add_argument("--logs-path", dest="logs_path", default=None)
+        p.add_argument("--profile", dest="profile_dir", default=None,
+                       help="write a jax.profiler trace of a few "
+                            "training steps to this directory")
+        p.add_argument("--profile_steps", dest="profile_steps", type=int,
+                       default=None)
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
 
@@ -246,6 +274,12 @@ class Config:
             cfg.USE_SAMPLED_SOFTMAX = True
         if ns.num_sampled is not None:
             cfg.NUM_SAMPLED_CLASSES = ns.num_sampled
+        if ns.encoder is not None:
+            cfg.ENCODER_TYPE = ns.encoder
+        if ns.xf_layers is not None:
+            cfg.XF_LAYERS = ns.xf_layers
+        if ns.xf_heads is not None:
+            cfg.XF_HEADS = ns.xf_heads
         if ns.head is not None:
             cfg.HEAD = ns.head
         cfg.HEAD_EXPLICIT = ns.head is not None
@@ -259,6 +293,8 @@ class Config:
             cfg.MESH_DATA_AXIS = ns.mesh_data
         if ns.mesh_model is not None:
             cfg.MESH_MODEL_AXIS = ns.mesh_model
+        if ns.mesh_context is not None:
+            cfg.MESH_CONTEXT_AXIS = ns.mesh_context
         if ns.seed is not None:
             cfg.SEED = ns.seed
         cfg.DIST_COORDINATOR = ns.dist_coordinator
@@ -266,6 +302,10 @@ class Config:
         cfg.DIST_PROCESS_ID = ns.dist_process_id
         if ns.logs_path is not None:
             cfg.LOG_PATH = ns.logs_path
+        if ns.profile_dir is not None:
+            cfg.PROFILE_DIR = ns.profile_dir
+        if ns.profile_steps is not None:
+            cfg.PROFILE_STEPS = ns.profile_steps
         if ns.verbose_mode is not None:
             cfg.VERBOSE_MODE = ns.verbose_mode
         cfg.verify()

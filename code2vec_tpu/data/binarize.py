@@ -10,6 +10,11 @@ shard is a [N, 1 + 3*C] int32 matrix per example row:
 padded positions hold the PAD index; the padding mask is recomputed at read
 time as `path != PAD` (a real context always has a path).
 
+A `<prefix>.bin.targets` sidecar stores one raw target string per example
+(same order), so evaluation — which needs the ORIGINAL name for subtoken
+metrics even when it is OOV in the target vocab — can also ride the
+binary fast path instead of seek-per-line text reads.
+
 Usage:
   python -m code2vec_tpu.data.binarize --data prefix  # binarizes
       prefix.{train,val,test}.c2v using prefix.dict.c2v vocabularies
@@ -35,19 +40,23 @@ def binarize_file(c2v_path: str, out_prefix: str, vocabs: Code2VecVocabs,
     row_width = 1 + 3 * C
     n_total = 0
     tmp_path = out_prefix + ".bin.tmp"
+    tgt_tmp = out_prefix + ".bin.targets.tmp"
     with open(c2v_path, "r", encoding="utf-8", errors="replace") as fin, \
-            open(tmp_path, "wb") as fout:
+            open(tmp_path, "wb") as fout, \
+            open(tgt_tmp, "w", encoding="utf-8") as ftgt:
         batch = []
         for line in fin:
             if not line.strip():
                 continue
             batch.append(line)
+            ftgt.write(line.split(" ", 1)[0].strip() + "\n")
             if len(batch) >= chunk:
                 n_total += _write_chunk(batch, fout, vocabs, C, row_width)
                 batch = []
         if batch:
             n_total += _write_chunk(batch, fout, vocabs, C, row_width)
     os.replace(tmp_path, out_prefix + ".bin")
+    os.replace(tgt_tmp, out_prefix + ".bin.targets")
     with open(out_prefix + ".bin.json", "w") as f:
         json.dump({"num_examples": n_total, "max_contexts": C,
                    "pad_index": vocabs.token_vocab.pad_index,

@@ -198,9 +198,16 @@ class BinaryShardReader:
     def __init__(self, prefix: str, batch_size: int, shuffle: bool = False,
                  seed: int = 0, host_shard: int = 0,
                  num_host_shards: int = 1,
-                 expected_max_contexts: Optional[int] = None):
+                 expected_max_contexts: Optional[int] = None,
+                 keep_strings: bool = False):
         with open(prefix + ".bin.json", "r") as f:
             self.manifest = json.load(f)
+        self.target_strings: Optional[List[str]] = None
+        if keep_strings:
+            # sidecar written by binarize: original target names, needed
+            # for subtoken metrics (OOV targets collapse in the vocab)
+            with open(prefix + ".bin.targets", encoding="utf-8") as f:
+                self.target_strings = [ln.rstrip("\n") for ln in f]
         self.max_contexts = int(self.manifest["max_contexts"])
         if (expected_max_contexts is not None
                 and expected_max_contexts != self.max_contexts):
@@ -231,19 +238,24 @@ class BinaryShardReader:
         emitted = 0
         for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]
-            rows = np.asarray(self.data[np.sort(idx)])
+            sorted_idx = np.sort(idx)
+            rows = np.asarray(self.data[sorted_idx])
             labels = rows[:, 0].astype(np.int32)
             src = rows[:, 1:1 + C]
             pth = rows[:, 1 + C:1 + 2 * C]
             dst = rows[:, 1 + 2 * C:1 + 3 * C]
             mask = (pth != self.pad_index).astype(np.float32)
             nv = rows.shape[0]
+            tstr = None
+            if self.target_strings is not None:
+                tstr = [self.target_strings[i] for i in sorted_idx]
             labels, src, pth, dst, mask = _pad_batch(
                 (labels, src, pth, dst, mask), self.batch_size)
             emitted += 1
             yield BatchTensors(labels, np.ascontiguousarray(src),
                                np.ascontiguousarray(pth),
-                               np.ascontiguousarray(dst), mask, nv)
+                               np.ascontiguousarray(dst), mask, nv,
+                               tstr)
         if self.num_host_shards > 1:
             target = _aligned_num_batches(self.num_examples,
                                           self.num_host_shards,
@@ -268,11 +280,14 @@ def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
     prefix = path_or_prefix
     if prefix.endswith(".c2v"):
         prefix = prefix[:-len(".c2v")]
-    if os.path.exists(prefix + ".bin.json") and not keep_strings:
+    have_bin = os.path.exists(prefix + ".bin.json")
+    have_targets = os.path.exists(prefix + ".bin.targets")
+    if have_bin and (not keep_strings or have_targets):
         return BinaryShardReader(prefix, batch_size, shuffle=shuffle,
                                  seed=seed, host_shard=host_shard,
                                  num_host_shards=num_host_shards,
-                                 expected_max_contexts=max_contexts)
+                                 expected_max_contexts=max_contexts,
+                                 keep_strings=keep_strings)
     return C2VTextReader(path_or_prefix, vocabs, max_contexts, batch_size,
                          shuffle=shuffle, seed=seed,
                          keep_strings=keep_strings, host_shard=host_shard,

@@ -46,6 +46,13 @@ class ModelDims:
     # dominates the java-large step (~30-40% end-to-end, measured on
     # v5e-lite; see BASELINE.md). TRANSFORM/ATTENTION always stay f32.
     tables_dtype: str = "float32"
+    # Encoder architecture: "bag" (the reference's single-query
+    # attention pool) or "transformer" (set transformer over the
+    # contexts, models/transformer_encoder.py; BASELINE.json configs[4]).
+    encoder_type: str = "bag"
+    xf_layers: int = 2
+    xf_heads: int = 4
+    xf_mlp_ratio: int = 4
 
     @property
     def context_vector_size(self) -> int:
@@ -72,7 +79,7 @@ def init_params(rng: jax.Array, dims: ModelDims,
     init = jax.nn.initializers.variance_scaling(
         1.0, "fan_avg", "uniform")
     t_dtype = jnp.dtype(dims.tables_dtype)
-    return {
+    params = {
         "token_emb": init(k_tok, (dims.padded(dims.token_vocab_size), E),
                           t_dtype),
         "path_emb": init(k_path, (dims.padded(dims.path_vocab_size), E),
@@ -82,6 +89,11 @@ def init_params(rng: jax.Array, dims: ModelDims,
         "transform": init(k_tr, (D, D), dtype),
         "attention": init(k_at, (D, 1), dtype)[:, 0],
     }
+    if dims.encoder_type == "transformer":
+        from code2vec_tpu.models.transformer_encoder import init_xf_params
+        params["xf"] = init_xf_params(
+            jax.random.fold_in(rng, 0x5f), dims)
+    return params
 
 
 def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
@@ -114,6 +126,18 @@ def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
         return code.astype(compute_dtype), attn
     return attention_pool(contexts, params["transform"],
                           params["attention"], mask)
+
+
+def get_encode_fn(dims: ModelDims):
+    """The encode callable for dims.encoder_type (same signature as
+    `encode`); the jitted steps in training/steps.py close over it."""
+    if dims.encoder_type == "transformer":
+        import functools
+
+        from code2vec_tpu.models.transformer_encoder import (
+            encode_transformer)
+        return functools.partial(encode_transformer, dims=dims)
+    return encode
 
 
 def logits_vs_table(table: jax.Array, code_vectors: jax.Array,

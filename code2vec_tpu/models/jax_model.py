@@ -51,8 +51,11 @@ class Code2VecModel(Code2VecModelBase):
         n_dev = len(jax.devices())
         self.mesh = None
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
-        if n_dev > 1 or model_axis > 1:
-            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis)
+        ctx_axis = max(1, cfg.MESH_CONTEXT_AXIS)
+        if n_dev > 1 or model_axis > 1 or ctx_axis > 1:
+            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis,
+                                  ctx_axis)
+        self.shard_contexts = ctx_axis > 1
 
         if cfg.is_loading:
             # Dims come from the checkpoint manifest, not the CLI: a model
@@ -80,6 +83,9 @@ class Code2VecModel(Code2VecModelBase):
                 dropout_keep_rate=cfg.DROPOUT_KEEP_RATE,
                 vocab_pad_multiple=model_axis,
                 tables_dtype=cfg.TABLES_DTYPE,
+                encoder_type=cfg.ENCODER_TYPE,
+                xf_layers=cfg.XF_LAYERS,
+                xf_heads=cfg.XF_HEADS,
             )
         from code2vec_tpu.training.optimizers import make_optimizer
         self.optimizer = make_optimizer(cfg.LEARNING_RATE,
@@ -167,7 +173,8 @@ class Code2VecModel(Code2VecModelBase):
                   b.context_valid_mask, weights)
         if self.mesh is not None:
             return shard_batch(self.mesh, arrays,
-                               process_local=process_local)
+                               process_local=process_local,
+                               shard_contexts=self.shard_contexts)
         return arrays
 
     def _ids_to_words(self, topk_ids: np.ndarray) -> List[List[str]]:

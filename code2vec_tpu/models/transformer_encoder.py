@@ -1,0 +1,133 @@
+"""Transformer path-encoder (BASELINE.json configs[4]).
+
+Replaces the reference's single-query attention pool with a set
+transformer over the ≤MAX_CONTEXTS path-contexts. Design notes
+(SURVEY.md §6 long-context row):
+
+- Contexts are an UNORDERED bag, so there is no positional encoding —
+  layers are permutation-equivariant (masked self-attention + MLP,
+  pre-LN), and the code vector comes from a learned-query attention
+  pool (PMA-style), which degenerates to exactly the reference's pool
+  at zero layers.
+- Everything is static-shape and jit-friendly; attention masks are
+  additive log-masks. Heads/layers live in ModelDims so the jitted
+  steps stay closed over static config.
+- Activations keep the [B, C, D] layout with the context dim second, so
+  a future context-parallel mesh axis shards `C` without a layout
+  change (the axis is reserved in parallel/mesh.py; at size 1 today the
+  sharding constraint is a no-op).
+- Params sit under one "xf" subtree (replicated on the mesh — they are
+  ~L*12*D^2 floats, tiny next to the vocab tables, which keep their
+  row-sharded TP layout from parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.models.encoder import ModelDims
+
+
+def init_xf_params(rng: jax.Array, dims: ModelDims) -> Dict:
+    """The "xf" subtree: input projection, L layers, pool query."""
+    D = dims.context_vector_size
+    H = dims.xf_heads
+    assert D % H == 0, f"context_vector_size {D} % heads {H} != 0"
+    mlp = dims.xf_mlp_ratio * D
+    init = jax.nn.initializers.variance_scaling(1.0, "fan_avg", "uniform")
+    keys = jax.random.split(rng, 2 + 4 * dims.xf_layers)
+    layers = []
+    for i in range(dims.xf_layers):
+        k_qkv, k_o, k_up, k_down = keys[2 + 4 * i: 6 + 4 * i]
+        layers.append({
+            "ln1_scale": jnp.ones((D,), jnp.float32),
+            "ln2_scale": jnp.ones((D,), jnp.float32),
+            "qkv": init(k_qkv, (D, 3 * D), jnp.float32),
+            "out": init(k_o, (D, D), jnp.float32),
+            "mlp_up": init(k_up, (D, mlp), jnp.float32),
+            "mlp_down": init(k_down, (mlp, D), jnp.float32),
+        })
+    return {
+        "ln_f_scale": jnp.ones((D,), jnp.float32),
+        "pool_query": init(keys[0], (D, 1), jnp.float32)[:, 0],
+        "in_proj": init(keys[1], (D, D), jnp.float32),
+        "layers": layers,
+    }
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
+            ).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
+         log_mask: jax.Array, heads: int) -> jax.Array:
+    B, C, D = x.shape
+    hd = D // heads
+    proj = x @ qkv.astype(x.dtype)                     # [B, C, 3D]
+    q, k, v = jnp.split(proj, 3, axis=-1)
+
+    def split_heads(t):
+        return t.reshape(B, C, heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(float(hd)) + log_mask[:, None, None, :]
+    attn = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, C, D)
+    return ctx @ out.astype(x.dtype)
+
+
+def encode_transformer(params: Dict, source_ids: jax.Array,
+                       path_ids: jax.Array, target_ids: jax.Array,
+                       mask: jax.Array, *,
+                       dims: ModelDims,
+                       dropout_rng: Optional[jax.Array] = None,
+                       dropout_keep_rate: float = 1.0,
+                       compute_dtype=jnp.float32,
+                       use_pallas: bool = False
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Same contract as encoder.encode: returns (code [B, D] in compute
+    dtype, pool attention [B, C] f32). `use_pallas` accepted for
+    interface parity (the layers are MXU matmuls XLA already fuses)."""
+    del use_pallas
+    xf = params["xf"]
+    emb = jnp.concatenate([
+        jnp.take(params["token_emb"], source_ids, axis=0),
+        jnp.take(params["path_emb"], path_ids, axis=0),
+        jnp.take(params["token_emb"], target_ids, axis=0),
+    ], axis=-1).astype(compute_dtype)                  # [B, C, D]
+
+    if dropout_rng is not None and dropout_keep_rate < 1.0:
+        keep = jax.random.bernoulli(dropout_rng, dropout_keep_rate,
+                                    emb.shape)
+        emb = jnp.where(keep, emb / dropout_keep_rate, 0.0)
+
+    # all-pad rows: keep one live key so softmax stays finite
+    safe_mask = jnp.where(jnp.sum(mask, axis=-1, keepdims=True) > 0,
+                          mask, jnp.ones_like(mask))
+    log_mask = jnp.log(jnp.maximum(safe_mask, 1e-30)).astype(jnp.float32)
+
+    x = emb @ xf["in_proj"].astype(compute_dtype)
+    for layer in xf["layers"]:
+        h = _rms_norm(x, layer["ln1_scale"])
+        x = x + _mha(h, layer["qkv"], layer["out"], log_mask,
+                     dims.xf_heads)
+        h = _rms_norm(x, layer["ln2_scale"])
+        h = jax.nn.gelu(h @ layer["mlp_up"].astype(compute_dtype))
+        x = x + h @ layer["mlp_down"].astype(compute_dtype)
+
+    x = _rms_norm(x, xf["ln_f_scale"])
+    # learned-query pool (the reference's attention pool, over the
+    # transformed representations)
+    pool_logits = (x.astype(jnp.float32)
+                   @ xf["pool_query"].astype(jnp.float32)) + log_mask
+    attn = jax.nn.softmax(pool_logits, axis=-1)        # [B, C]
+    code = jnp.einsum("bc,bcd->bd", attn.astype(compute_dtype), x)
+    return code, attn

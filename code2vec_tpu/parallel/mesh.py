@@ -17,32 +17,42 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA_AXIS = "data"
+CONTEXT_AXIS = "ctx"
 MODEL_AXIS = "model"
 
 
-def make_mesh(data: int = 0, model: int = 1,
+def make_mesh(data: int = 0, model: int = 1, context: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ('data', 'model') mesh.
+    """Build a ('data', 'ctx', 'model') mesh.
 
     data=0 means "use all remaining devices on the data axis". For
     multi-host runs `jax.devices()` already spans hosts, so the same call
     produces a global mesh (jax.distributed.initialize is handled by the
     trainer entry point).
+
+    The 'ctx' axis (default size 1, a no-op) is the context/sequence-
+    parallel axis reserved for the transformer path-encoder
+    (SURVEY.md §6 long-context row): sharding the MAX_CONTEXTS dim of
+    [B, C, D] activations over it makes XLA insert the attention
+    all-gathers over ICI — tested in tests/test_transformer.py.
     """
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
-    if model <= 0:
-        model = 1
+    model = max(1, model)
+    context = max(1, context)
     if data <= 0:
-        if n % model != 0:
-            raise ValueError(f"{n} devices not divisible by model={model}")
-        data = n // model
-    if data * model != n:
-        # Allow a mesh over a subset only when explicitly requested.
-        if data * model > n:
+        if n % (model * context) != 0:
             raise ValueError(
-                f"mesh {data}x{model} needs {data * model} devices, "
+                f"{n} devices not divisible by model*ctx="
+                f"{model * context}")
+        data = n // (model * context)
+    need = data * model * context
+    if need != n:
+        # Allow a mesh over a subset only when explicitly requested.
+        if need > n:
+            raise ValueError(
+                f"mesh {data}x{context}x{model} needs {need} devices, "
                 f"have {n}")
-        devs = devs[: data * model]
-    arr = np.asarray(devs).reshape(data, model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        devs = devs[:need]
+    arr = np.asarray(devs).reshape(data, context, model)
+    return Mesh(arr, (DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))

@@ -21,7 +21,7 @@ from typing import Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from code2vec_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
 
 
 def param_pspecs() -> Dict[str, P]:
@@ -32,12 +32,21 @@ def param_pspecs() -> Dict[str, P]:
         "transform": P(None, None),
         "attention": P(None),
         "vm_pointer": P(None, None),   # VarMisuse head (tiny: replicated)
+        # transformer encoder subtree ("xf"): one sharding for every leaf
+        # (replicated — ~L*12*D^2 floats, tiny next to the vocab tables)
+        "xf": P(),
     }
 
 
 def batch_pspec() -> P:
     """Leading (batch) dim over 'data'; everything else replicated."""
     return P(DATA_AXIS)
+
+
+def context_batch_pspec() -> P:
+    """[B, C] tensors with the context dim sharded over 'ctx' — the
+    sequence/context-parallel layout for the transformer encoder."""
+    return P(DATA_AXIS, CONTEXT_AXIS)
 
 
 def shard_params(mesh: Mesh, params) -> Dict[str, jax.Array]:
@@ -51,10 +60,12 @@ def shard_opt_state(mesh: Mesh, opt_state, params):
     replicate."""
     specs = param_pspecs()
     # optax states are pytrees whose array leaves either match a param
-    # shape (moments) or are scalars (counts). Map by shape.
+    # shape (moments) or are scalars (counts). Map by shape. Subtree
+    # params (e.g. "xf") contribute every leaf under their one spec.
     shapes_to_spec = {}
     for k, v in params.items():
-        shapes_to_spec.setdefault(v.shape, specs[k])
+        for leaf in jax.tree_util.tree_leaves(v):
+            shapes_to_spec.setdefault(leaf.shape, specs[k])
 
     def put(leaf):
         if hasattr(leaf, "shape") and leaf.shape in shapes_to_spec:
@@ -67,9 +78,12 @@ def shard_opt_state(mesh: Mesh, opt_state, params):
     return jax.tree_util.tree_map(put, opt_state)
 
 
-def shard_batch(mesh: Mesh, arrays, *, process_local: bool = True):
+def shard_batch(mesh: Mesh, arrays, *, process_local: bool = True,
+                shard_contexts: bool = False):
     """Put a tuple of [B, ...] host arrays onto the mesh with the batch
-    dim over 'data'.
+    dim over 'data'. With shard_contexts=True, [B, C] arrays
+    additionally shard their context dim over 'ctx' (context
+    parallelism for the transformer encoder).
 
     Multi-process semantics depend on what the caller's B means:
 
@@ -85,15 +99,20 @@ def shard_batch(mesh: Mesh, arrays, *, process_local: bool = True):
     """
     import numpy as np
 
-    sh = NamedSharding(mesh, batch_pspec())
+    def sharding_for(a):
+        if shard_contexts and getattr(a, "ndim", 1) == 2:
+            return NamedSharding(mesh, context_batch_pspec())
+        return NamedSharding(mesh, batch_pspec())
+
     if jax.process_count() == 1:
-        return tuple(jax.device_put(a, sh) for a in arrays)
+        return tuple(jax.device_put(a, sharding_for(a)) for a in arrays)
     if process_local:
         return tuple(
-            jax.make_array_from_process_local_data(sh, np.asarray(a))
+            jax.make_array_from_process_local_data(sharding_for(a),
+                                                   np.asarray(a))
             for a in arrays)
     return tuple(
         jax.make_array_from_callback(
-            np.asarray(a).shape, sh,
+            np.asarray(a).shape, sharding_for(a),
             lambda idx, _a=np.asarray(a): _a[idx])
         for a in arrays)

@@ -60,6 +60,10 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
         "dropout_keep_rate": dims.dropout_keep_rate,
         "vocab_pad_multiple": dims.vocab_pad_multiple,
         "tables_dtype": dims.tables_dtype,
+        "encoder_type": dims.encoder_type,
+        "xf_layers": dims.xf_layers,
+        "xf_heads": dims.xf_heads,
+        "xf_mlp_ratio": dims.xf_mlp_ratio,
         "step": step,
     }
     if extra_manifest:
@@ -95,6 +99,10 @@ def load_dims(ckpt_dir: str) -> ModelDims:
         dropout_keep_rate=m["dropout_keep_rate"],
         vocab_pad_multiple=m.get("vocab_pad_multiple", 1),
         tables_dtype=m.get("tables_dtype", "float32"),
+        encoder_type=m.get("encoder_type", "bag"),
+        xf_layers=m.get("xf_layers", 2),
+        xf_heads=m.get("xf_heads", 4),
+        xf_mlp_ratio=m.get("xf_mlp_ratio", 4),
     )
 
 

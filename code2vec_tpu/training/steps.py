@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from code2vec_tpu.models.encoder import (ModelDims, encode, full_logits)
+from code2vec_tpu.models.encoder import (ModelDims, full_logits,
+                                         get_encode_fn)
 from code2vec_tpu.ops.sampled_softmax import sampled_softmax_loss
 
 
@@ -41,6 +42,8 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
     (params, opt_state, loss)` where batch is a 6-tuple of arrays
     (labels [B], src/path/dst ids [B, C], mask [B, C],
     example_weights [B])."""
+
+    encode = get_encode_fn(dims)
 
     def loss_fn(params, labels, src, pth, dst, mask, weights, rng):
         drop_rng, sample_rng = jax.random.split(rng)
@@ -77,6 +80,7 @@ def make_eval_step(dims: ModelDims, *, top_k: int = 10,
                    use_pallas: bool = False) -> Callable:
     """Returns jitted `step(params, batch) -> (loss_sum, topk_ids,
     topk_probs)`; no dropout (SURVEY.md §4.3)."""
+    encode = get_encode_fn(dims)
 
     @jax.jit
     def step(params, batch):
@@ -100,6 +104,7 @@ def make_encode_step(dims: ModelDims, *,
     """Returns jitted `step(params, batch) -> code_vectors [B, D] f32` —
     encoder only, no [B, V] logits matmul. Used by --export_code_vectors
     over a whole test split, where top-k/softmax would be wasted FLOPs."""
+    encode = get_encode_fn(dims)
 
     @jax.jit
     def step(params, batch):
@@ -119,6 +124,7 @@ def make_predict_step(dims: ModelDims, *, top_k: int = 10,
     attention, code_vectors)` — the predict graph additionally surfaces
     per-context attention and the code vector (SURVEY.md §4.4,
     interpretability output + --export_code_vectors)."""
+    encode = get_encode_fn(dims)
 
     @jax.jit
     def step(params, batch):

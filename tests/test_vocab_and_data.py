@@ -107,6 +107,49 @@ def test_binary_reader_matches_text_reader(tmp_path):
                                       bb.context_valid_mask)
 
 
+def test_binary_eval_fast_path_carries_target_strings(tmp_path):
+    """evaluate()'s keep_strings path must ride the binary shards: the
+    `.bin.targets` sidecar round-trips ORIGINAL names (incl. targets
+    that are OOV in the vocab) in example order."""
+    import os
+
+    from code2vec_tpu.data.reader import open_reader
+
+    prefix = build_tiny_dataset(str(tmp_path), n_train=32, n_val=4,
+                                n_test=4, max_contexts=8, binarize=True)
+    vocabs = load_tiny_vocabs(prefix)
+    # inject an OOV-target row and re-binarize (string must survive)
+    with open(prefix + ".train.c2v", "a") as f:
+        f.write("totally|novel|name foo,123456,bar"
+                + " " * 0 + "\n")
+    from code2vec_tpu.data import binarize as binarize_mod
+    binarize_mod.main(["--data", prefix, "--max_contexts", "8",
+                       "--word_vocab_size", "1000",
+                       "--path_vocab_size", "1000",
+                       "--target_vocab_size", "1000"])
+    assert os.path.exists(prefix + ".train.bin.targets")
+
+    # with the sidecar present, open_reader picks binary for eval too
+    binary = open_reader(prefix + ".train.c2v", vocabs, 8, 8,
+                         keep_strings=True)
+    assert isinstance(binary, BinaryShardReader)
+
+    tb = list(C2VTextReader(prefix + ".train.c2v", vocabs, 8,
+                            batch_size=8, keep_strings=True))
+    bb = list(binary)
+    assert len(tb) == len(bb)
+    for t, b in zip(tb, bb):
+        assert b.target_strings is not None
+        assert t.target_strings[:t.num_valid_examples] \
+            == b.target_strings[:b.num_valid_examples]
+        np.testing.assert_array_equal(t.target_index, b.target_index)
+    # the OOV name survived as a string in the last batch
+    last = bb[-1]
+    assert "totally|novel|name" in last.target_strings
+    assert last.target_index[last.target_strings.index(
+        "totally|novel|name")] == vocabs.target_vocab.oov_index
+
+
 def test_reader_shuffle_is_seeded_and_complete(tmp_path):
     prefix = build_tiny_dataset(str(tmp_path), n_train=16, n_val=2,
                                 n_test=2, max_contexts=8)

@@ -29,11 +29,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 VARIANTS = {
-    # name: (use_sampled, tables_dtype, embedding_optimizer)
-    "full-f32-adam": (False, "float32", "adam"),
-    "sampled-f32-adam": (True, "float32", "adam"),
-    "sampled-bf16-adam": (True, "bfloat16", "adam"),
-    "sampled-bf16-adafactor": (True, "bfloat16", "adafactor"),
+    # name: (use_sampled, tables_dtype, embedding_optimizer, encoder)
+    "full-f32-adam": (False, "float32", "adam", "bag"),
+    "sampled-f32-adam": (True, "float32", "adam", "bag"),
+    "sampled-bf16-adam": (True, "bfloat16", "adam", "bag"),
+    "sampled-bf16-adafactor": (True, "bfloat16", "adafactor", "bag"),
+    "sampled-bf16-xf2": (True, "bfloat16", "adam", "transformer"),
 }
 
 
@@ -42,7 +43,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
 
-    use_sampled, tdtype, eopt = VARIANTS[name]
+    use_sampled, tdtype, eopt, encoder = VARIANTS[name]
     cfg = Config(
         MAX_CONTEXTS=200,
         MAX_TOKEN_VOCAB_SIZE=150_000,
@@ -59,6 +60,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         NUM_SAMPLED_CLASSES=num_sampled,
         TABLES_DTYPE=tdtype,
         EMBEDDING_OPTIMIZER=eopt,
+        ENCODER_TYPE=encoder,
     )
     cfg.train_data_path = data
     cfg.test_data_path = data + ".val.c2v"
@@ -72,6 +74,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         "use_sampled_softmax": use_sampled,
         "tables_dtype": tdtype,
         "embedding_optimizer": eopt,
+        "encoder": encoder,
         "epochs": epochs,
         "steps": model.step_num,
         "train_seconds": round(train_s, 1),
