@@ -337,6 +337,21 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
                 "the adam embedding optimizer.")
+        if self.SPARSE_EMBEDDING_UPDATES and self.ENCODER_TYPE != "bag":
+            # sparse_steps hard-codes the bag attention pool and would
+            # silently leave transformer params untrained while eval runs
+            # them — a train/eval architecture mismatch.
+            raise ValueError(
+                "SPARSE_EMBEDDING_UPDATES supports the bag encoder only "
+                "(sparse_steps.py trains no transformer params).")
+        if self.HEAD == "varmisuse" and (self.ENCODER_TYPE != "bag"
+                                         or self.MESH_CONTEXT_AXIS > 1):
+            # vm_scores calls the bag encode() directly; accepting
+            # --encoder transformer here would silently train the wrong
+            # architecture.
+            raise ValueError(
+                "--head varmisuse supports the bag encoder only "
+                "(no --encoder transformer / --mesh_context > 1).")
 
     def get_logger(self) -> logging.Logger:
         if self._logger is None:

@@ -229,6 +229,10 @@ class Code2VecModel(Code2VecModelBase):
         acc = MetricAccumulator(
             cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)
         for batch in reader:
+            # TODO(multi-host): every host parses and feeds the identical
+            # full eval batch (correct, but H× redundant host-side text
+            # parsing at pod scale); shard the file per host and allgather
+            # metric partials instead if eval ever dominates.
             dev_batch = self._device_batch(batch, process_local=False)
             loss_sum, topk_ids, _ = self._eval_step(self.params, dev_batch)
             nv = batch.num_valid_examples

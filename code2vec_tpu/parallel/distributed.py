@@ -36,12 +36,26 @@ _MULTIHOST_ENV_MARKERS = (
 
 
 def _looks_multihost() -> bool:
+    # CODE2VEC_DIST_DISABLE=1 is the escape hatch for processes launched
+    # inside an allocation that *looks* multi-task but isn't one JAX job
+    # (e.g. one task of a heterogeneous Slurm job): initialize() would
+    # otherwise block forever waiting for peers that never connect.
+    if os.environ.get("CODE2VEC_DIST_DISABLE", "").lower() in (
+            "1", "true", "yes"):
+        return False
     if any(os.environ.get(k) for k in _MULTIHOST_ENV_MARKERS):
         return True
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     if len([h for h in hostnames.split(",") if h.strip()]) > 1:
         return True
-    return int(os.environ.get("SLURM_NTASKS") or 1) > 1
+    # Slurm: SLURM_NTASKS>1 alone is too weak a signal (a single-task
+    # step inside a multi-task allocation inherits it); require the
+    # per-step variables JAX's Slurm cluster detection actually consumes
+    # to be consistent too.
+    ntasks = int(os.environ.get("SLURM_STEP_NUM_TASKS")
+                 or os.environ.get("SLURM_NTASKS") or 1)
+    return ntasks > 1 and "SLURM_PROCID" in os.environ \
+        and "SLURM_STEP_NODELIST" in os.environ
 
 _initialized = False
 
@@ -79,6 +93,12 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes,
                       process_id=process_id)
+    if log is not None:
+        # initialize() blocks until every peer connects — announce first
+        # so a mis-detected topology is debuggable rather than a silent
+        # hang (set CODE2VEC_DIST_DISABLE=1 to skip auto-detection).
+        log(f"initializing jax.distributed (explicit={explicit}) — "
+            "blocks until all peers connect")
     jax.distributed.initialize(**kwargs)
     _initialized = True
     if log is not None:

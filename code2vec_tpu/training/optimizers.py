@@ -16,16 +16,65 @@ vocab tables:
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 TABLE_PARAMS = ("token_emb", "path_emb", "target_emb")
+
+
+def scale_by_adam_f32_moments(b1: float = 0.9, b2: float = 0.999,
+                              eps: float = 1e-8
+                              ) -> optax.GradientTransformation:
+    """scale_by_adam that keeps mu AND nu in float32 regardless of the
+    parameter dtype.
+
+    With bf16 vocab tables, stock optax.adam inherits bf16 for both
+    moments (mu/nu = zeros_like(param)); the second-moment increment
+    (1-b2)*g^2 = 1e-3*g^2 underflows bf16's 8-bit mantissa once it drops
+    below ~1/256 of the running value, risking a quiet late-training
+    stall at java-large scale (round-2 advisor finding). f32 moments are
+    measured perf-neutral on v5e-lite (BASELINE.md phase isolation:
+    15.6 ms f32 vs 15.9 ms bf16 moment traffic — the update kernel is
+    not moment-traffic-bound), so this is the default for "adam".
+    Residual caveat: the *applied update* still rounds to the bf16
+    table, which the 50K-corpus quality study validates (BASELINE.md).
+    """
+
+    def init_fn(params):
+        f32_zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(f32_zeros, params),
+            nu=jax.tree_util.tree_map(f32_zeros, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), updates)
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1.0 - b1) * g, state.mu, g32)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1.0 - b2) * (g * g), state.nu, g32)
+        count = optax.safe_int32_increment(state.count)
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v, u: ((m / bc1) / (jnp.sqrt(v / bc2) + eps)
+                             ).astype(u.dtype),
+            mu, nu, updates)
+        return new_updates, optax.ScaleByAdamState(count=count, mu=mu,
+                                                   nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def make_optimizer(learning_rate: float,
                    embedding_optimizer: str = "adam"
                    ) -> optax.GradientTransformation:
     if embedding_optimizer == "adam":
-        return optax.adam(learning_rate)
+        return optax.chain(scale_by_adam_f32_moments(),
+                           optax.scale(-learning_rate))
     if embedding_optimizer == "adafactor":
         # label by key so extra head params (e.g. vm_pointer) route to
         # adam automatically

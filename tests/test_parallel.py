@@ -32,7 +32,7 @@ def _batch(rng, b=16):
 
 def test_mesh_shapes():
     mesh = make_mesh(0, 2)
-    assert mesh.shape == {"data": 4, "model": 2}
+    assert mesh.shape == {"data": 4, "ctx": 1, "model": 2}
     with pytest.raises(ValueError):
         make_mesh(3, 3)
 
