@@ -41,12 +41,18 @@ class BatchTensors(NamedTuple):
 
 
 def parse_c2v_rows(lines: List[str], vocabs: Code2VecVocabs,
-                   max_contexts: int, keep_strings: bool = False):
+                   max_contexts: int, keep_strings: bool = False,
+                   sample_seed: int = 0):
     """Vectorized-enough parse of `.c2v` rows into index arrays.
 
     A context field is `left,path,right`; empty ('' or ',,') fields are
     padding (PAD index, mask 0). OOV words map to the OOV index
-    (SURVEY.md §3.2).
+    (SURVEY.md §3.2). Rows with more than `max_contexts` contexts (raw
+    extractor output on the predict path — preprocessed files are already
+    capped) are downsampled uniformly without replacement, matching the
+    reference preprocess behavior (SURVEY.md §3 preprocess row: "truncate
+    each method's contexts to 200 (random sample when over)"); seeded for
+    reproducible predictions.
     """
     n = len(lines)
     tok_v, path_v, tgt_v = (vocabs.token_vocab, vocabs.path_vocab,
@@ -62,10 +68,24 @@ def parse_c2v_rows(lines: List[str], vocabs: Code2VecVocabs,
         parts = line.rstrip("\n").split(" ")
         target = parts[0]
         labels[i] = tgt_v.lookup_index(target)
+        ctxs = parts[1:]
+        if len(ctxs) > max_contexts:
+            # drop pad fields ('' / ',,' — preprocess pads rows to a fixed
+            # width) before sampling so only REAL contexts compete for
+            # the max_contexts slots
+            real = [c for c in ctxs if c and c != ",,"]
+            if len(real) > max_contexts:
+                # per-row seed: a row's sample must not depend on which
+                # other over-cap rows precede it in the batch
+                rng = np.random.default_rng((sample_seed, i))
+                pick = np.sort(rng.choice(len(real), size=max_contexts,
+                                          replace=False))
+                real = [real[k] for k in pick]
+            ctxs = real
         if keep_strings:
             target_strings.append(target)
-            context_strings.append(parts[1:1 + max_contexts])
-        for j, ctx in enumerate(parts[1:1 + max_contexts]):
+            context_strings.append(ctxs)
+        for j, ctx in enumerate(ctxs):
             if not ctx or ctx == ",,":
                 continue
             fields = ctx.split(",")
@@ -238,6 +258,12 @@ class BinaryShardReader:
         emitted = 0
         for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]
+            # Within-batch ascending order turns the memmap fancy-index
+            # into a forward-only disk read (big win on cold page cache).
+            # SGD-safe: batch MEMBERSHIP stays the shuffled permutation;
+            # only the order of rows inside one batch changes, which the
+            # batch-mean loss is invariant to (target_strings are
+            # reindexed identically below).
             sorted_idx = np.sort(idx)
             rows = np.asarray(self.data[sorted_idx])
             labels = rows[:, 0].astype(np.int32)

@@ -30,6 +30,7 @@ from code2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
+from code2vec_tpu.training.profiler import StepProfiler
 from code2vec_tpu.training.steps import (make_encode_step, make_eval_step,
                                          make_predict_step, make_train_step)
 from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, VocabType
@@ -193,13 +194,18 @@ class Code2VecModel(Code2VecModelBase):
                  f"devices={len(jax.devices())}, mesh={self.mesh}")
         window_examples = 0
         window_start = time.time()
+        profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
+                                cfg.PROFILE_STEPS, self.log)
+        steps_into_training = 0
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
             for batch in reader:
+                profiler.tick(steps_into_training, self.params)
                 dev_batch = self._device_batch(batch)
                 self.rng, step_rng = jax.random.split(self.rng)
                 self.params, self.opt_state, loss = self._train_step(
                     self.params, self.opt_state, dev_batch, step_rng)
                 self.step_num += 1
+                steps_into_training += 1
                 window_examples += batch.num_valid_examples
                 if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
                     loss_f = float(loss)  # device sync only on log steps
@@ -217,6 +223,7 @@ class Code2VecModel(Code2VecModelBase):
             if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                 results = self.evaluate()
                 self.log(f"epoch {epoch} evaluation: {results}")
+        profiler.finish(self.params)
         self.log("training done")
 
     # ---- evaluate (SURVEY.md §4.3) ----

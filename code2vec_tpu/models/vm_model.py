@@ -24,6 +24,7 @@ from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
 from code2vec_tpu.training.optimizers import make_optimizer
+from code2vec_tpu.training.profiler import StepProfiler
 from code2vec_tpu.training.vm_steps import (make_vm_eval_step,
                                             make_vm_train_step)
 
@@ -131,8 +132,13 @@ class VarMisuseModel:
         self.log(f"varmisuse training: dims={self.dims}, "
                  f"max_candidates={cfg.MAX_CANDIDATES}")
         window, t0 = 0, time.time()
+        profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
+                                cfg.PROFILE_STEPS, self.log)
+        steps_into_training = 0
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
             for batch in reader:
+                profiler.tick(steps_into_training, self.params)
+                steps_into_training += 1
                 dev_batch = self._device_batch(batch)
                 self.rng, k = jax.random.split(self.rng)
                 self.params, self.opt_state, loss = self._train_step(
@@ -149,6 +155,7 @@ class VarMisuseModel:
                 self.save()
             if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                 self.log(f"vm epoch {epoch}: {self.evaluate()}")
+        profiler.finish(self.params)
         self.log("varmisuse training done")
 
     def evaluate(self, split_path: Optional[str] = None) -> VMEvalResults:

@@ -137,3 +137,18 @@ def test_sampled_softmax_training_works(dataset, tmp_path):
     after = model.evaluate()
     assert after.loss < before.loss
     assert after.topk_acc[0] > 0.2
+
+
+def test_profile_flag_writes_trace(dataset, tmp_path):
+    import os
+    trace_dir = str(tmp_path / "trace")
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=1,
+                      PROFILE_DIR=trace_dir, PROFILE_START_STEP=1,
+                      PROFILE_STEPS=3)
+    model = Code2VecModel(cfg)
+    model.train()
+    # jax.profiler writes plugins/profile/<run>/*.xplane.pb under the dir
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(f for f in files if f.endswith(".xplane.pb"))
+    assert found, f"no trace files under {trace_dir}"

@@ -77,6 +77,31 @@ def test_row_longer_than_max_contexts_truncates():
     assert mask.sum() == 4
 
 
+def test_row_longer_than_max_contexts_samples_not_head():
+    """Over-cap rows are downsampled (reference preprocess samples, not
+    first-N), deterministically for a fixed seed."""
+    vocabs = Code2VecVocabs(
+        Vocab(VocabType.Token, [f"w{i}" for i in range(20)]),
+        Vocab(VocabType.Path, ["1"]), Vocab(VocabType.Target, ["t"]))
+    line = "t " + " ".join(f"w{i},1,w{i}" for i in range(20))
+    _, src, _, _, mask, _, cstr = parse_c2v_rows(
+        [line], vocabs, max_contexts=4, keep_strings=True)
+    assert mask.sum() == 4
+    picked = {int(w) for w in
+              (c.split(",")[0][1:] for c in cstr[0])}
+    # deterministic across calls
+    _, src2, _, _, _, _, cstr2 = parse_c2v_rows(
+        [line], vocabs, max_contexts=4, keep_strings=True)
+    assert cstr2[0] == cstr[0]
+    assert (src2 == src).all()
+    # not simply the first four contexts (seeded sample spreads out)
+    assert picked != {0, 1, 2, 3}
+    # kept strings correspond to the sampled ids
+    tv = vocabs.token_vocab
+    assert [tv.lookup_word(int(i)) for i in src[0]] == \
+        [c.split(",")[0] for c in cstr[0]]
+
+
 def test_text_reader_batching_and_final_pad(tmp_path):
     prefix = build_tiny_dataset(str(tmp_path), n_train=10, n_val=2,
                                 n_test=2, max_contexts=8)
@@ -165,3 +190,19 @@ def test_reader_shuffle_is_seeded_and_complete(tmp_path):
     b3 = next(iter(r3))
     assert sorted(b1.target_index.tolist()) == sorted(
         b3.target_index.tolist())
+
+
+def test_over_cap_sampling_ignores_pad_fields():
+    """A preprocessed row padded to a larger width than the run's
+    max_contexts must keep ALL its real contexts (pads don't compete
+    for slots) — regression for sampling across padding fields."""
+    vocabs = Code2VecVocabs(
+        Vocab(VocabType.Token, ["a", "b", "c"]),
+        Vocab(VocabType.Path, ["1"]), Vocab(VocabType.Target, ["t"]))
+    line = "t a,1,a b,1,b c,1,c " + " ".join([""] * 5)
+    _, src, _, _, mask, _, _ = parse_c2v_rows([line], vocabs,
+                                              max_contexts=4)
+    assert mask.sum() == 3
+    tv = vocabs.token_vocab
+    assert {int(src[0, j]) for j in range(3)} == {
+        tv.lookup_index("a"), tv.lookup_index("b"), tv.lookup_index("c")}
