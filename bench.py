@@ -3,12 +3,24 @@
 
 Prints ONE JSON line:
   {"metric": "path-contexts/sec/chip", "value": N, "unit": "...",
-   "vs_baseline": N}
+   "vs_baseline": N, ...}
 
 Metric (BASELINE.json): path-contexts/sec/chip on java-large =
 examples/sec * MAX_CONTEXTS(200), measured over the jitted training step
 (sampled softmax over the 261K-name target vocab — the north-star
-java-large configuration; full vocab tables at reference capacity).
+java-large configuration; full vocab tables at reference capacity),
+using the SHIPPED config: bf16 tables, f32-moment Adam
+(training/optimizers.make_optimizer), bf16 compute, Pallas pool on TPU.
+
+Extra keys:
+  - hbm_gbps / hbm_ceiling_gbps: achieved HBM bandwidth of the step
+    (analytic streaming-traffic model below / measured step time) vs the
+    measured 1-GiB-copy streaming ceiling on this chip. The step is
+    HBM-bound (BASELINE.md "Phase isolation"), so hbm_gbps close to the
+    ceiling means the config is at its roofline and further per-chip
+    gains need less *traffic*, not better overlap.
+  - transformer_*: the same measurement for --encoder transformer
+    (xf_layers=2), the BASELINE.json configs[4] stretch encoder.
 
 Baseline denominator: derived, methodology-documented single-V100
 estimate of the reference step (fp32, full softmax, dense Adam, input
@@ -41,31 +53,64 @@ WARMUP_STEPS = 5
 MEASURE_STEPS = 40
 
 
-def main() -> None:
+def _step_hbm_bytes(params, opt_state) -> int:
+    """Analytic per-step HBM traffic of the table-dominated phases
+    (BASELINE.md "Phase isolation" — the step is streaming-bound on
+    exactly this traffic):
+
+      backward: dense grad buffer written once per table (grad dtype ==
+                param dtype under value_and_grad);
+      optimizer: grads read, params read + written, every optimizer-state
+                leaf (Adam mu/nu, f32 since round 3) read + written.
+
+    Gathers/activations (~0.3 GB at B=1024, and running at random-access
+    bandwidth, not streaming) are excluded — this is a lower bound, so
+    achieved GB/s derived from it is conservative."""
+    import jax
+
+    total = 0
+    for p in jax.tree_util.tree_leaves(params):
+        b = p.size * p.dtype.itemsize
+        total += b * 4  # grad write + grad read + param read + write
+    for s in jax.tree_util.tree_leaves(opt_state):
+        total += s.size * s.dtype.itemsize * 2  # state read + write
+    return total
+
+
+def _measure_hbm_ceiling() -> float:
+    """Streaming bandwidth ceiling (ops/membench.py — shared with
+    tools/profile_step.py)."""
+    from code2vec_tpu.ops.membench import measure_hbm_ceiling
+    return measure_hbm_ceiling()
+
+
+def _measure_encoder(encoder_type: str):
+    """Build the shipped train step for one encoder and time it.
+    Returns (path_contexts_per_sec, ms_per_step, hbm_gbps)."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.training.optimizers import make_optimizer
     from code2vec_tpu.training.steps import make_train_step
 
-    # the shipped default config (config.py): bf16 tables (quality-
-    # validated in BASELINE.md's 50K-vocab study), bf16 compute, Pallas
-    # pool on TPU, sampled softmax, dense Adam
     dims = ModelDims(token_vocab_size=TOKEN_VOCAB,
                      path_vocab_size=PATH_VOCAB,
                      target_vocab_size=TARGET_VOCAB,
                      embeddings_size=128, max_contexts=MAX_CONTEXTS,
-                     tables_dtype="bfloat16")
+                     tables_dtype="bfloat16", encoder_type=encoder_type,
+                     xf_layers=2, xf_heads=4)
     params = init_params(jax.random.PRNGKey(0), dims)
-    optimizer = optax.adam(1e-3)
+    optimizer = make_optimizer(1e-3)  # shipped default: f32-moment Adam
     opt_state = optimizer.init(params)
+    hbm_bytes = _step_hbm_bytes(params, opt_state)
     step = make_train_step(dims, optimizer, use_sampled_softmax=True,
                            num_sampled=NUM_SAMPLED,
                            compute_dtype=jnp.bfloat16,
                            use_pallas=jax.default_backend() == "tpu")
 
     r = np.random.default_rng(0)
+
     def batch_for(i):
         labels = r.integers(0, TARGET_VOCAB, size=(BATCH,), dtype=np.int32)
         src = r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
@@ -82,30 +127,42 @@ def main() -> None:
     rng = jax.random.PRNGKey(1)
     # a few distinct host batches so we're not timing a cached input
     batches = [batch_for(i) for i in range(4)]
-    for i in range(WARMUP_STEPS):
-        rng, k = jax.random.split(rng)
-        params, opt_state, loss = step(params, opt_state,
-                                       batches[i % len(batches)], k)
-    float(loss)  # hard sync; block_until_ready can return early on the
-    # tunneled axon platform, so sync via a host transfer instead
 
-    t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        rng, k = jax.random.split(rng)
-        params, opt_state, loss = step(params, opt_state,
-                                       batches[i % len(batches)], k)
-    # single hard sync at the end: the donated-params chain serializes all
-    # MEASURE_STEPS steps, so this bounds the full computation
-    float(loss)
-    dt = time.perf_counter() - t0
+    def chain(n, params, opt_state, rng):
+        """Run n chained steps; the donated-params chain serializes them,
+        so the final host transfer bounds the full computation."""
+        t0 = time.perf_counter()
+        for i in range(n):
+            rng, k = jax.random.split(rng)
+            params, opt_state, loss = step(params, opt_state,
+                                           batches[i % len(batches)], k)
+        float(loss)  # hard sync; block_until_ready can return early on
+        # the tunneled axon platform
+        return time.perf_counter() - t0, params, opt_state, rng
 
-    examples_per_sec = MEASURE_STEPS * BATCH / dt
-    value = examples_per_sec * MAX_CONTEXTS
+    # slope timing: two chain lengths, differenced — cancels the fixed
+    # ~100 ms dispatch/sync overhead of the tunneled platform
+    _, params, opt_state, rng = chain(WARMUP_STEPS, params, opt_state,
+                                      rng)
+    t1, params, opt_state, rng = chain(10, params, opt_state, rng)
+    t2, params, opt_state, rng = chain(10 + MEASURE_STEPS, params,
+                                       opt_state, rng)
+    dt = (t2 - t1) / MEASURE_STEPS
+
+    pc_per_sec = BATCH * MAX_CONTEXTS / dt
+    return pc_per_sec, dt * 1e3, hbm_bytes / dt / 1e9
+
+
+def main() -> None:
+    ceiling = _measure_hbm_ceiling()
+    value, ms, hbm_gbps = _measure_encoder("bag")
+    xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
     print(json.dumps({
         "metric": "path-contexts/sec/chip",
         "value": round(value, 1),
         "unit": "path-contexts/sec/chip (java-large, sampled softmax, "
-                "batch 1024, bf16 compute + bf16 tables)",
+                "batch 1024, bf16 compute + bf16 tables, f32-moment "
+                "Adam)",
         "vs_baseline": round(value / V100_BASELINE_PATH_CONTEXTS_PER_SEC,
                              3),
         "baseline_denominator": V100_BASELINE_PATH_CONTEXTS_PER_SEC,
@@ -116,6 +173,15 @@ def main() -> None:
         "vs_baseline_band": [
             round(value / V100_BASELINE_BAND[1], 3),
             round(value / V100_BASELINE_BAND[0], 3)],
+        "ms_per_step": round(ms, 2),
+        "hbm_gbps": round(hbm_gbps, 1),
+        "hbm_ceiling_gbps": round(ceiling / 1e9, 1),
+        "hbm_utilization": round(hbm_gbps / (ceiling / 1e9), 3),
+        "transformer_pc_per_sec": round(xf_value, 1),
+        "transformer_ms_per_step": round(xf_ms, 2),
+        "transformer_hbm_gbps": round(xf_hbm, 1),
+        "transformer_vs_baseline": round(
+            xf_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
     }))
 
 

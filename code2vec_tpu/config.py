@@ -85,6 +85,9 @@ class Config:
     ENCODER_TYPE: str = "bag"
     XF_LAYERS: int = 2
     XF_HEADS: int = 4
+    # Per-layer rematerialization (jax.checkpoint) for deep encoders —
+    # required at CodeBERT depth (12 layers) to keep activations O(1).
+    XF_REMAT: bool = False
 
     # ---- task head: "code2vec" (method-name prediction, reference
     # parity) or "varmisuse" (pointer-style variable-misuse repair,
@@ -216,6 +219,8 @@ class Config:
                        default=None)
         p.add_argument("--xf_heads", dest="xf_heads", type=int,
                        default=None)
+        p.add_argument("--xf_remat", dest="xf_remat",
+                       action="store_true")
         p.add_argument("--head", dest="head", default=None,
                        choices=["code2vec", "varmisuse"])
         p.add_argument("--max_candidates", dest="max_candidates",
@@ -280,6 +285,8 @@ class Config:
             cfg.XF_LAYERS = ns.xf_layers
         if ns.xf_heads is not None:
             cfg.XF_HEADS = ns.xf_heads
+        if ns.xf_remat:
+            cfg.XF_REMAT = True
         if ns.head is not None:
             cfg.HEAD = ns.head
         cfg.HEAD_EXPLICIT = ns.head is not None

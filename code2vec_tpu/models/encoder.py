@@ -53,6 +53,11 @@ class ModelDims:
     xf_layers: int = 2
     xf_heads: int = 4
     xf_mlp_ratio: int = 4
+    # Rematerialize each transformer layer in the backward pass
+    # (jax.checkpoint): trades ~30% more FLOPs for O(layers) -> O(1)
+    # activation memory — required to fit CodeBERT-depth (12-layer)
+    # encoders at B*C activation scale (SURVEY.md "HBM bandwidth" row).
+    xf_remat: bool = False
 
     @property
     def context_vector_size(self) -> int:

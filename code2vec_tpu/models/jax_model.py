@@ -87,6 +87,7 @@ class Code2VecModel(Code2VecModelBase):
                 encoder_type=cfg.ENCODER_TYPE,
                 xf_layers=cfg.XF_LAYERS,
                 xf_heads=cfg.XF_HEADS,
+                xf_remat=cfg.XF_REMAT,
             )
         from code2vec_tpu.training.optimizers import make_optimizer
         self.optimizer = make_optimizer(cfg.LEARNING_RATE,

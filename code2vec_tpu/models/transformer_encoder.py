@@ -114,14 +114,21 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
                           mask, jnp.ones_like(mask))
     log_mask = jnp.log(jnp.maximum(safe_mask, 1e-30)).astype(jnp.float32)
 
-    x = emb @ xf["in_proj"].astype(compute_dtype)
-    for layer in xf["layers"]:
+    def layer_fn(x, layer):
         h = _rms_norm(x, layer["ln1_scale"])
         x = x + _mha(h, layer["qkv"], layer["out"], log_mask,
                      dims.xf_heads)
         h = _rms_norm(x, layer["ln2_scale"])
         h = jax.nn.gelu(h @ layer["mlp_up"].astype(compute_dtype))
-        x = x + h @ layer["mlp_down"].astype(compute_dtype)
+        return x + h @ layer["mlp_down"].astype(compute_dtype)
+
+    if dims.xf_remat:
+        # O(1)-in-depth activation memory for CodeBERT-scale encoders
+        layer_fn = jax.checkpoint(layer_fn)
+
+    x = emb @ xf["in_proj"].astype(compute_dtype)
+    for layer in xf["layers"]:
+        x = layer_fn(x, layer)
 
     x = _rms_norm(x, xf["ln_f_scale"])
     # learned-query pool (the reference's attention pool, over the

@@ -64,6 +64,7 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
         "xf_layers": dims.xf_layers,
         "xf_heads": dims.xf_heads,
         "xf_mlp_ratio": dims.xf_mlp_ratio,
+        "xf_remat": dims.xf_remat,
         "step": step,
     }
     if extra_manifest:
@@ -103,6 +104,7 @@ def load_dims(ckpt_dir: str) -> ModelDims:
         xf_layers=m.get("xf_layers", 2),
         xf_heads=m.get("xf_heads", 4),
         xf_mlp_ratio=m.get("xf_mlp_ratio", 4),
+        xf_remat=m.get("xf_remat", False),
     )
 
 

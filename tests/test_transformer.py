@@ -157,3 +157,42 @@ def test_transformer_model_end_to_end(tmp_path):
     assert model2.dims.encoder_type == "transformer"
     loaded = model2.evaluate()
     assert loaded.topk_acc == pytest.approx(xf_eval.topk_acc)
+
+
+def test_xf_remat_identical_numerics():
+    """xf_remat recomputes activations in backward but must not change
+    forward values or gradients (CodeBERT-depth memory knob)."""
+    import dataclasses
+
+    from code2vec_tpu.training.steps import make_train_step
+    dims_r = dataclasses.replace(DIMS, xf_remat=True)
+    p = init_params(jax.random.PRNGKey(5), DIMS)
+    labels, src, pth, dst, mask, w = example_batch(5, DIMS, 4)
+    batch = tuple(jnp.asarray(a) for a in
+                  (labels, src, pth, dst, mask, w))
+    opt = optax.adam(0.01)
+    outs = []
+    for d in (DIMS, dims_r):
+        step = make_train_step(d, opt)
+        p2, _, loss = step(jax.tree_util.tree_map(jnp.copy, p),
+                           opt.init(p), batch, jax.random.PRNGKey(6))
+        outs.append((np.asarray(p2["xf"]["layers"][0]["qkv"]),
+                     float(loss)))
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6)
+    np.testing.assert_allclose(outs[0][0], outs[1][0], atol=1e-6)
+
+
+def test_checkpoint_roundtrips_xf_remat(tmp_path):
+    import dataclasses
+
+    from code2vec_tpu.training import checkpoint as ckpt
+    from code2vec_tpu.vocab.vocabularies import (Code2VecVocabs, Vocab,
+                                                 VocabType)
+    dims_r = dataclasses.replace(DIMS, xf_remat=True)
+    p = init_params(jax.random.PRNGKey(7), dims_r)
+    vocabs = Code2VecVocabs(Vocab(VocabType.Token, ["a"]),
+                            Vocab(VocabType.Path, ["1"]),
+                            Vocab(VocabType.Target, ["t"]))
+    ckpt.save_checkpoint(str(tmp_path / "c"), {"params": p, "step": 0},
+                         0, vocabs, dims_r)
+    assert ckpt.load_dims(str(tmp_path / "c")).xf_remat is True

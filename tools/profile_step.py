@@ -1,13 +1,15 @@
 #!/usr/bin/env python3
 """Phase-level profile of the java-large training step on the local chip.
 
-Times, via the donated-chain + host-transfer sync that is reliable on the
-tunneled axon platform (see bench.py), each of:
+Times, SLOPE-TIMED (two chained-run lengths, differenced — the tunneled
+axon platform adds ~2 ms per dispatched call plus ~100 ms fixed sync
+cost, which single-chain timing cannot separate; see BASELINE.md round-3
+methodology note), each of:
 
-  - HBM streaming bandwidth (copy of a ~1 GB buffer) — the ceiling
+  - HBM streaming bandwidth (fold-resistant in-jit copy loop) — ceiling
   - forward only (encode + sampled softmax loss)
   - forward + backward (grads materialized)
-  - full step (fwd + bwd + Adam), per optimizer variant
+  - full step (fwd + bwd + optimizer), adam and adafactor
 
 Usage: python tools/profile_step.py [--batch 1024] [--steps 20]
 """
@@ -32,14 +34,23 @@ NUM_SAMPLED = 4096
 
 
 def timeit(fn, sync, steps, warmup=3):
-    for _ in range(warmup):
-        out = fn()
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn()
-    sync(out)
-    return (time.perf_counter() - t0) / steps
+    """Slope timing: run chains of `steps` and `3*steps` calls and
+    difference them, cancelling both the fixed sync overhead and (to
+    first order) nothing else — per-call dispatch cost is part of the
+    steady-state step cost and is retained deliberately (a real train
+    loop pays it too)."""
+    def chain(n):
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        sync(out)
+        return time.perf_counter() - t0
+
+    chain(warmup)
+    t1 = chain(steps)
+    t2 = chain(3 * steps)
+    return (t2 - t1) / (2 * steps)
 
 
 def main() -> None:
@@ -51,7 +62,6 @@ def main() -> None:
 
     import jax
     import jax.numpy as jnp
-    import optax
 
     from code2vec_tpu.models.encoder import ModelDims, encode, init_params
     from code2vec_tpu.ops.sampled_softmax import sampled_softmax_loss
@@ -73,17 +83,11 @@ def main() -> None:
     batch = (labels, src, pth, dst, mask, weights)
     rng = jax.random.PRNGKey(1)
 
-    # ---- HBM streaming ceiling ----
-    big = jnp.zeros((256 * 1024 * 1024 // 4,), jnp.float32)  # 1 GiB
+    # ---- HBM streaming ceiling (shared helper, ops/membench.py) ----
+    from code2vec_tpu.ops.membench import measure_hbm_ceiling
 
-    @jax.jit
-    def copy(x):
-        return x * 1.0000001
-
-    dt = timeit(lambda: copy(big), lambda o: float(o[0]), 8)
-    bw = 2 * big.size * 4 / dt
-    print(f"HBM streaming (1 GiB copy): {dt*1e3:.2f} ms "
-          f"-> {bw/1e9:.0f} GB/s effective")
+    bw = measure_hbm_ceiling()
+    print(f"HBM streaming (1 GiB copy): {bw/1e9:.0f} GB/s effective")
 
     # ---- forward only ----
     def loss_fn(params, rng):
@@ -122,12 +126,15 @@ def main() -> None:
         print(f"{label}: {dt*1e3:6.2f} ms -> {pc/1e6:.2f}M pc/s")
         return dt
 
-    opt = optax.adam(1e-3)
-    step = make_train_step(dims, opt, use_sampled_softmax=True,
-                           num_sampled=NUM_SAMPLED,
-                           compute_dtype=jnp.bfloat16,
-                           use_pallas=jax.default_backend() == "tpu")
-    run_full("full step (dense Adam, f32 moments)", step, opt.init(params))
+    from code2vec_tpu.training.optimizers import make_optimizer
+
+    for oname in ("adam", "adafactor"):
+        opt = make_optimizer(1e-3, oname)
+        step = make_train_step(dims, opt, use_sampled_softmax=True,
+                               num_sampled=NUM_SAMPLED,
+                               compute_dtype=jnp.bfloat16,
+                               use_pallas=jax.default_backend() == "tpu")
+        run_full(f"full step ({oname})", step, opt.init(params))
 
 
 if __name__ == "__main__":
