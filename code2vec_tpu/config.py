@@ -69,10 +69,14 @@ class Config:
     # BASELINE.md — so it is the default; --tables_dtype float32
     # restores exact reference numerics.
     TABLES_DTYPE: str = "bfloat16"  # "float32" | "bfloat16"
-    # Optimizer for the vocab tables: "adam" (reference parity) or
-    # "adafactor" (factored second moment, no momentum — the standard
-    # large-embedding-table practice; see training/optimizers.py).
-    EMBEDDING_OPTIMIZER: str = "adam"
+    # Optimizer for the vocab tables: "adafactor" (factored second
+    # moment, no momentum — the standard large-embedding-table practice)
+    # or "adam" (reference parity). Adafactor is the default since
+    # round 3: it is both the fastest step (26.0 vs 33-35 ms at
+    # java-large B=1024) AND the highest-F1 sampled variant on the
+    # 50K-corpus study (0.9145 vs 0.9042; BASELINE.md round-3 quality
+    # table). `--embedding_optimizer adam` restores reference parity.
+    EMBEDDING_OPTIMIZER: str = "adafactor"
     # Fused Pallas attention-pool kernel (ops/pallas_attention.py):
     # ~1.5x faster than the XLA pool in isolation on v5e (4.9 vs 7.7 ms
     # at B=1024). Default on; it only takes effect on a TPU backend

@@ -72,8 +72,11 @@ class Code2VecModel(Code2VecModelBase):
             cfg.SPARSE_EMBEDDING_UPDATES = manifest.get(
                 "sparse_embedding_updates", cfg.SPARSE_EMBEDDING_UPDATES)
             cfg.TABLES_DTYPE = self.dims.tables_dtype
+            # fallback "adam", NOT the current default: checkpoints
+            # predating the manifest key were trained with Adam, and an
+            # adafactor template would fail orbax structure matching
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
-                "embedding_optimizer", cfg.EMBEDDING_OPTIMIZER)
+                "embedding_optimizer", "adam")
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -99,6 +102,13 @@ class Code2VecModel(Code2VecModelBase):
         self.rng, init_rng = jax.random.split(self.rng)
         params = init_params(init_rng, self.dims)
         if cfg.SPARSE_EMBEDDING_UPDATES:
+            # Config.verify() enforces this for CLI runs; assert here so
+            # programmatic Config users get a clear error instead of an
+            # optax chain-state mismatch (adafactor became the default
+            # table optimizer in round 3, sparse_steps is adam-only).
+            assert cfg.EMBEDDING_OPTIMIZER == "adam", (
+                "SPARSE_EMBEDDING_UPDATES requires "
+                "EMBEDDING_OPTIMIZER='adam'")
             from code2vec_tpu.training.sparse_steps import (
                 init_sparse_opt_state)
             opt_state = init_sparse_opt_state(params, self.optimizer,

@@ -62,8 +62,10 @@ class VarMisuseModel:
             cfg.MAX_CANDIDATES = manifest.get("max_candidates",
                                               cfg.MAX_CANDIDATES)
             cfg.TABLES_DTYPE = self.dims.tables_dtype
+            # fallback "adam" (the pre-manifest-key default), not the
+            # current adafactor default — see jax_model.py
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
-                "embedding_optimizer", cfg.EMBEDDING_OPTIMIZER)
+                "embedding_optimizer", "adam")
             self.vocabs = ckpt.load_vocabs(cfg.load_path)
         else:
             assert cfg.train_data_path, "varmisuse needs --data or --load"

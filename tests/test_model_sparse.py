@@ -23,7 +23,8 @@ def _cfg(prefix, **kw):
                  SAVE_EVERY_EPOCHS=100, NUM_BATCHES_TO_LOG_PROGRESS=1000,
                  LEARNING_RATE=0.05, USE_BF16=False,
                  SPARSE_EMBEDDING_UPDATES=True,
-                 TABLES_DTYPE="float32")  # sparse path is f32-only
+                 TABLES_DTYPE="float32",  # sparse path is f32-only
+                 EMBEDDING_OPTIMIZER="adam")  # ... and adam-only
     cfg.train_data_path = prefix
     cfg.test_data_path = prefix + ".test.c2v"
     for k, v in kw.items():

@@ -89,6 +89,9 @@ def main() -> None:
         SEED=args.seed, USE_SAMPLED_SOFTMAX=True,
         NUM_SAMPLED_CLASSES=args.num_sampled,
         TABLES_DTYPE=args.tables_dtype,
+        # the probes read Adam's mu/nu chain state — pin adam explicitly
+        # (the shipped default is adafactor, whose state is factored)
+        EMBEDDING_OPTIMIZER="adam",
     )
     cfg.train_data_path = args.data
     cfg.test_data_path = args.data + ".val.c2v"
