@@ -296,6 +296,23 @@ class BinaryShardReader:
                     np.zeros((B, C), np.float32), 0)
 
 
+def count_examples(path_or_prefix: str) -> int:
+    """Number of examples in a split — from the binary manifest when
+    available (O(1)), else a line count. Used to size LR schedules."""
+    prefix = path_or_prefix
+    if prefix.endswith(".c2v"):
+        prefix = prefix[:-len(".c2v")]
+    if os.path.exists(prefix + ".bin.json"):
+        with open(prefix + ".bin.json") as f:
+            return int(json.load(f)["num_examples"])
+    n = 0
+    with open(path_or_prefix, "rb") as f:
+        for raw in f:
+            if raw.strip():
+                n += 1
+    return n
+
+
 def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
                 max_contexts: int, batch_size: int, shuffle: bool = False,
                 seed: int = 0, keep_strings: bool = False,

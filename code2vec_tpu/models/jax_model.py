@@ -77,6 +77,15 @@ class Code2VecModel(Code2VecModelBase):
             # adafactor template would fail orbax structure matching
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
+            # resume must rebuild the same opt_state structure (a
+            # schedule adds a count leaf to the scale transform)
+            ckpt_schedule = manifest.get("lr_schedule", "constant")
+            if cfg.LR_SCHEDULE != ckpt_schedule:
+                cfg.log(
+                    f"--lr_schedule {cfg.LR_SCHEDULE!r} ignored: using "
+                    f"the checkpoint's {ckpt_schedule!r} (the optimizer "
+                    "state structure is fixed at first training)")
+            cfg.LR_SCHEDULE = ckpt_schedule
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -92,9 +101,37 @@ class Code2VecModel(Code2VecModelBase):
                 xf_heads=cfg.XF_HEADS,
                 xf_remat=cfg.XF_REMAT,
             )
-        from code2vec_tpu.training.optimizers import make_optimizer
-        self.optimizer = make_optimizer(cfg.LEARNING_RATE,
-                                        cfg.EMBEDDING_OPTIMIZER)
+        from code2vec_tpu.training.optimizers import make_lr, make_optimizer
+        # The schedule must match what the checkpoint's opt_state was
+        # built with (a schedule adds a count leaf to the LR transform),
+        # including eval/predict-only loads — cfg.LR_SCHEDULE already
+        # carries the manifest value when loading.
+        schedule = cfg.LR_SCHEDULE
+        total_steps = 0
+        if schedule != "constant":
+            if cfg.is_training:
+                # dict pickle already carries the count; rescan the file
+                # only for foreign datasets missing it
+                n = self.vocabs.num_training_examples
+                if not n:
+                    from code2vec_tpu.data.reader import count_examples
+                    n = count_examples(cfg.data_path("train"))
+                per_host = -(-n // jax.process_count())
+                total_steps = (-(-per_host // cfg.TRAIN_BATCH_SIZE)
+                               * cfg.NUM_TRAIN_EPOCHS)
+                if cfg.is_loading:
+                    # the restored opt_state count already sits at the
+                    # checkpoint's step; extend the horizon so the
+                    # resumed epochs decay over (restored, restored+new]
+                    # instead of clamping to the 10% floor immediately
+                    total_steps += int(manifest.get("step", 0))
+            else:
+                # eval/predict take no optimizer steps; any positive
+                # horizon yields the right opt_state STRUCTURE
+                total_steps = 1
+        self.optimizer = make_optimizer(
+            make_lr(cfg.LEARNING_RATE, schedule, total_steps),
+            cfg.EMBEDDING_OPTIMIZER)
         self.rng = jax.random.PRNGKey(cfg.SEED)
 
         # ---- params: load (--load) or init ----
@@ -109,6 +146,10 @@ class Code2VecModel(Code2VecModelBase):
             assert cfg.EMBEDDING_OPTIMIZER == "adam", (
                 "SPARSE_EMBEDDING_UPDATES requires "
                 "EMBEDDING_OPTIMIZER='adam'")
+            assert cfg.LR_SCHEDULE == "constant", (
+                "SPARSE_EMBEDDING_UPDATES requires "
+                "LR_SCHEDULE='constant' (the row-update kernel applies "
+                "a fixed per-row learning rate)")
             from code2vec_tpu.training.sparse_steps import (
                 init_sparse_opt_state)
             opt_state = init_sparse_opt_state(params, self.optimizer,
@@ -143,6 +184,7 @@ class Code2VecModel(Code2VecModelBase):
                 make_sparse_train_step)
             self._train_step = make_sparse_train_step(
                 self.dims, learning_rate=cfg.LEARNING_RATE,
+                dense_optimizer=self.optimizer,
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
                 num_sampled=cfg.NUM_SAMPLED_CLASSES,
                 compute_dtype=self.compute_dtype)
@@ -329,7 +371,11 @@ class Code2VecModel(Code2VecModelBase):
                  "num_sampled": self.config.NUM_SAMPLED_CLASSES,
                  "sparse_embedding_updates":
                      self.config.SPARSE_EMBEDDING_UPDATES,
-                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER}
+                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
+                 # always the EFFECTIVE schedule: for loaded models the
+                 # manifest override already set cfg.LR_SCHEDULE to what
+                 # the saved opt_state structure carries
+                 "lr_schedule": self.config.LR_SCHEDULE}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

@@ -66,6 +66,7 @@ class VarMisuseModel:
             # current adafactor default — see jax_model.py
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
+            cfg.LR_SCHEDULE = manifest.get("lr_schedule", "constant")
             self.vocabs = ckpt.load_vocabs(cfg.load_path)
         else:
             assert cfg.train_data_path, "varmisuse needs --data or --load"
@@ -82,8 +83,27 @@ class VarMisuseModel:
                 vocab_pad_multiple=model_axis,
                 tables_dtype=cfg.TABLES_DTYPE,
             )
-        self.optimizer = make_optimizer(cfg.LEARNING_RATE,
-                                        cfg.EMBEDDING_OPTIMIZER)
+        # schedule handling mirrors jax_model.py: structure must match
+        # the checkpoint's; eval-only loads need only the structure
+        from code2vec_tpu.training.optimizers import make_lr
+        schedule = cfg.LR_SCHEDULE
+        total_steps = 0
+        if schedule != "constant":
+            if cfg.is_training:
+                from code2vec_tpu.data.reader import count_examples
+                per_host = -(-count_examples(self._vm_path("train"))
+                             // jax.process_count())
+                total_steps = (-(-per_host // cfg.TRAIN_BATCH_SIZE)
+                               * cfg.NUM_TRAIN_EPOCHS)
+                if cfg.is_loading:
+                    # extend the horizon past the restored step count
+                    # (see jax_model.py)
+                    total_steps += int(manifest.get("step", 0))
+            else:
+                total_steps = 1
+        self.optimizer = make_optimizer(
+            make_lr(cfg.LEARNING_RATE, schedule, total_steps),
+            cfg.EMBEDDING_OPTIMIZER)
         self.rng = jax.random.PRNGKey(cfg.SEED)
         self.rng, init_rng = jax.random.split(self.rng)
         params = init_vm_params(init_rng, self.dims)
@@ -209,7 +229,8 @@ class VarMisuseModel:
                  "step": self.step_num}
         extra = {"head": "varmisuse",
                  "max_candidates": self.config.MAX_CANDIDATES,
-                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER}
+                 "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
+                 "lr_schedule": self.config.LR_SCHEDULE}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

@@ -72,12 +72,39 @@ def scale_by_adam_f32_moments(b1: float = 0.9, b2: float = 0.999,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def make_optimizer(learning_rate: float,
+def make_lr(learning_rate: float, schedule: str = "constant",
+            total_steps: int = 0):
+    """Returns a float or an optax schedule.
+
+    The reference trains at constant LR (TF AdamOptimizer default —
+    parity). "cosine" decays to 10% of peak over total_steps: the decay
+    study (tools/sampled_decay_study.py, BASELINE.md round 3) shows the
+    sampled-softmax head-class top1 decay is full-LR negative-pressure
+    overshoot — head rows keep receiving ~every-step negative updates
+    after converging, and at lr=1e-3 they drift off their optimum late
+    in training (at lr=5e-4 the decay vanishes, Adam nu stays flat so
+    it is not an effective-LR spike). A decaying schedule removes the
+    pathology without relying on bf16 rounding noise.
+    """
+    if schedule == "constant":
+        return learning_rate
+    assert total_steps > 0, f"--lr_schedule {schedule} needs total_steps"
+    if schedule == "cosine":
+        return optax.cosine_decay_schedule(learning_rate, total_steps,
+                                           alpha=0.1)
+    if schedule == "linear":
+        return optax.linear_schedule(learning_rate, learning_rate * 0.1,
+                                     total_steps)
+    raise ValueError(f"unknown lr schedule {schedule!r}")
+
+
+def make_optimizer(learning_rate,
                    embedding_optimizer: str = "adafactor"
                    ) -> optax.GradientTransformation:
+    """`learning_rate` is a float or an optax schedule (see make_lr)."""
     if embedding_optimizer == "adam":
         return optax.chain(scale_by_adam_f32_moments(),
-                           optax.scale(-learning_rate))
+                           optax.scale_by_learning_rate(learning_rate))
     if embedding_optimizer == "adafactor":
         # label by key so extra head params (e.g. vm_pointer) route to
         # adam automatically

@@ -24,7 +24,8 @@ def _cfg(prefix, **kw):
                  LEARNING_RATE=0.05, USE_BF16=False,
                  SPARSE_EMBEDDING_UPDATES=True,
                  TABLES_DTYPE="float32",  # sparse path is f32-only
-                 EMBEDDING_OPTIMIZER="adam")  # ... and adam-only
+                 EMBEDDING_OPTIMIZER="adam",  # ... and adam-only
+                 LR_SCHEDULE="constant")  # ... at constant LR
     cfg.train_data_path = prefix
     cfg.test_data_path = prefix + ".test.c2v"
     for k, v in kw.items():

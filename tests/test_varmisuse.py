@@ -159,3 +159,23 @@ def test_vm_cli_flag_validation(vm_dataset):
     cfg.load_path = "whatever"
     with pytest.raises(ValueError):
         cfg.verify()
+
+
+def test_vm_cosine_schedule_trains(vm_dataset, tmp_path):
+    """--lr_schedule is wired through the varmisuse head too (total
+    steps sized from the .vm.c2v split)."""
+    from code2vec_tpu.models.vm_model import VarMisuseModel
+    cfg = vm_config(vm_dataset, NUM_TRAIN_EPOCHS=3, LR_SCHEDULE="cosine")
+    cfg.save_path = str(tmp_path / "vmck")
+    m = VarMisuseModel(cfg)
+    m.train()
+    m.save()
+    res = m.evaluate(m._vm_path("train"))
+    assert res.accuracy > 0.3
+    # eval-only load restores the schedule-bearing opt_state structure
+    cfg2 = vm_config(vm_dataset)
+    cfg2.train_data_path = None
+    cfg2.load_path = str(tmp_path / "vmck")
+    cfg2.test_data_path = "unused"
+    m2 = VarMisuseModel(cfg2)
+    assert cfg2.LR_SCHEDULE == "cosine"

@@ -40,6 +40,7 @@ VARIANTS = {
 
 def run_variant(name: str, data: str, epochs: int, batch: int,
                 num_sampled: int, seed: int, lr: float = 1e-3,
+                lr_schedule: str = "constant",
                 save_path: str = None) -> dict:
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
@@ -56,6 +57,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         SAVE_EVERY_EPOCHS=1000,
         NUM_BATCHES_TO_LOG_PROGRESS=100,
         LEARNING_RATE=lr,
+        LR_SCHEDULE=lr_schedule,
         SEED=seed,
         USE_SAMPLED_SOFTMAX=use_sampled,
         NUM_SAMPLED_CLASSES=num_sampled,
@@ -84,6 +86,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         "epochs": epochs,
         "batch": batch,
         "lr": lr,
+        "lr_schedule": lr_schedule,
         "steps": model.step_num,
         "train_seconds": round(train_s, 1),
         "val_loss": round(float(res.loss), 4),
@@ -108,6 +111,8 @@ def main() -> None:
                          "(VERDICT r2 item 1a: large-batch convergence "
                          "neutrality)")
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--lr_schedule", default="constant",
+                    choices=["constant", "cosine", "linear"])
     ap.add_argument("--num_sampled", type=int, default=1024)
     ap.add_argument("--seed", type=int, default=239)
     ap.add_argument("--variants", default=",".join(VARIANTS))
@@ -122,6 +127,7 @@ def main() -> None:
     for name in args.variants.split(","):
         r = run_variant(name.strip(), args.data, args.epochs, args.batch,
                         args.num_sampled, args.seed, lr=args.lr,
+                        lr_schedule=args.lr_schedule,
                         save_path=(args.save + "." + name.strip()
                                    if args.save else None))
         results.append(r)
