@@ -138,6 +138,11 @@ class Config:
     PROFILE_STEPS: int = 10
     PROFILE_START_STEP: int = 5  # skip compile + warmup steps
 
+    # ---- optional TensorBoard scalars (SURVEY.md §6 metrics row):
+    # --tensorboard <dir> streams train loss/throughput + eval metrics
+    # as tf.summary scalars (host-side; TF is imported only when set).
+    TENSORBOARD_DIR: Optional[str] = None
+
     def __post_init__(self) -> None:
         if self.TARGET_EMBEDDINGS_SIZE is None:
             self.TARGET_EMBEDDINGS_SIZE = self.code_vector_size
@@ -261,6 +266,10 @@ class Config:
                             "training steps to this directory")
         p.add_argument("--profile_steps", dest="profile_steps", type=int,
                        default=None)
+        p.add_argument("--tensorboard", dest="tensorboard_dir",
+                       default=None,
+                       help="write loss/throughput/eval scalars as "
+                            "TensorBoard summaries to this directory")
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
 
@@ -329,6 +338,8 @@ class Config:
             cfg.PROFILE_DIR = ns.profile_dir
         if ns.profile_steps is not None:
             cfg.PROFILE_STEPS = ns.profile_steps
+        if ns.tensorboard_dir is not None:
+            cfg.TENSORBOARD_DIR = ns.tensorboard_dir
         if ns.verbose_mode is not None:
             cfg.VERBOSE_MODE = ns.verbose_mode
         cfg.verify()

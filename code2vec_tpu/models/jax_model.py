@@ -77,15 +77,10 @@ class Code2VecModel(Code2VecModelBase):
             # adafactor template would fail orbax structure matching
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
-            # resume must rebuild the same opt_state structure (a
-            # schedule adds a count leaf to the scale transform)
-            ckpt_schedule = manifest.get("lr_schedule", "constant")
-            if cfg.LR_SCHEDULE != ckpt_schedule:
-                cfg.log(
-                    f"--lr_schedule {cfg.LR_SCHEDULE!r} ignored: using "
-                    f"the checkpoint's {ckpt_schedule!r} (the optimizer "
-                    "state structure is fixed at first training)")
-            cfg.LR_SCHEDULE = ckpt_schedule
+            from code2vec_tpu.training.optimizers import (
+                resolve_checkpoint_schedule)
+            cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
+                cfg.LR_SCHEDULE, manifest, cfg.log)
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -106,6 +101,7 @@ class Code2VecModel(Code2VecModelBase):
         # built with (a schedule adds a count leaf to the LR transform),
         # including eval/predict-only loads — cfg.LR_SCHEDULE already
         # carries the manifest value when loading.
+        from code2vec_tpu.training.optimizers import schedule_total_steps
         schedule = cfg.LR_SCHEDULE
         total_steps = 0
         if schedule != "constant":
@@ -116,15 +112,11 @@ class Code2VecModel(Code2VecModelBase):
                 if not n:
                     from code2vec_tpu.data.reader import count_examples
                     n = count_examples(cfg.data_path("train"))
-                per_host = -(-n // jax.process_count())
-                total_steps = (-(-per_host // cfg.TRAIN_BATCH_SIZE)
-                               * cfg.NUM_TRAIN_EPOCHS)
-                if cfg.is_loading:
-                    # the restored opt_state count already sits at the
-                    # checkpoint's step; extend the horizon so the
-                    # resumed epochs decay over (restored, restored+new]
-                    # instead of clamping to the 10% floor immediately
-                    total_steps += int(manifest.get("step", 0))
+                total_steps = schedule_total_steps(
+                    n, cfg.TRAIN_BATCH_SIZE, cfg.NUM_TRAIN_EPOCHS,
+                    num_hosts=jax.process_count(),
+                    restored_step=(int(manifest.get("step", 0))
+                                   if cfg.is_loading else 0))
             else:
                 # eval/predict take no optimizer steps; any positive
                 # horizon yields the right opt_state STRUCTURE
@@ -249,6 +241,9 @@ class Code2VecModel(Code2VecModelBase):
         window_start = time.time()
         profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
                                 cfg.PROFILE_STEPS, self.log)
+        from code2vec_tpu.training.scalars import ScalarWriter
+        scalars = ScalarWriter(cfg.TENSORBOARD_DIR
+                               if jax.process_index() == 0 else None)
         steps_into_training = 0
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
             for batch in reader:
@@ -270,13 +265,25 @@ class Code2VecModel(Code2VecModelBase):
                         f"epoch {epoch} step {self.step_num}: "
                         f"loss {loss_f:.4f}, {ex_s:.1f} ex/s, "
                         f"{ex_s * cfg.MAX_CONTEXTS:.0f} path-contexts/s")
+                    scalars.write(self.step_num, {
+                        "train/loss": loss_f,
+                        "train/examples_per_sec": ex_s,
+                        "train/path_contexts_per_sec":
+                            ex_s * cfg.MAX_CONTEXTS})
                     window_examples, window_start = 0, time.time()
             if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                 self.save(cfg.save_path)
             if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                 results = self.evaluate()
                 self.log(f"epoch {epoch} evaluation: {results}")
+                scalars.write(self.step_num, {
+                    "eval/loss": results.loss,
+                    "eval/top1": results.topk_acc[0],
+                    "eval/subtoken_f1": results.subtoken_f1,
+                    "eval/subtoken_precision": results.subtoken_precision,
+                    "eval/subtoken_recall": results.subtoken_recall})
         profiler.finish(self.params)
+        scalars.close()
         self.log("training done")
 
     # ---- evaluate (SURVEY.md §4.3) ----

@@ -66,7 +66,10 @@ class VarMisuseModel:
             # current adafactor default — see jax_model.py
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
-            cfg.LR_SCHEDULE = manifest.get("lr_schedule", "constant")
+            from code2vec_tpu.training.optimizers import (
+                resolve_checkpoint_schedule)
+            cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
+                cfg.LR_SCHEDULE, manifest, cfg.log)
             self.vocabs = ckpt.load_vocabs(cfg.load_path)
         else:
             assert cfg.train_data_path, "varmisuse needs --data or --load"
@@ -85,20 +88,19 @@ class VarMisuseModel:
             )
         # schedule handling mirrors jax_model.py: structure must match
         # the checkpoint's; eval-only loads need only the structure
-        from code2vec_tpu.training.optimizers import make_lr
+        from code2vec_tpu.training.optimizers import (make_lr,
+                                                      schedule_total_steps)
         schedule = cfg.LR_SCHEDULE
         total_steps = 0
         if schedule != "constant":
             if cfg.is_training:
                 from code2vec_tpu.data.reader import count_examples
-                per_host = -(-count_examples(self._vm_path("train"))
-                             // jax.process_count())
-                total_steps = (-(-per_host // cfg.TRAIN_BATCH_SIZE)
-                               * cfg.NUM_TRAIN_EPOCHS)
-                if cfg.is_loading:
-                    # extend the horizon past the restored step count
-                    # (see jax_model.py)
-                    total_steps += int(manifest.get("step", 0))
+                total_steps = schedule_total_steps(
+                    count_examples(self._vm_path("train")),
+                    cfg.TRAIN_BATCH_SIZE, cfg.NUM_TRAIN_EPOCHS,
+                    num_hosts=jax.process_count(),
+                    restored_step=(int(manifest.get("step", 0))
+                                   if cfg.is_loading else 0))
             else:
                 total_steps = 1
         self.optimizer = make_optimizer(

@@ -98,6 +98,31 @@ def make_lr(learning_rate: float, schedule: str = "constant",
     raise ValueError(f"unknown lr schedule {schedule!r}")
 
 
+def schedule_total_steps(num_examples: int, batch_size: int, epochs: int,
+                         num_hosts: int = 1,
+                         restored_step: int = 0) -> int:
+    """Decay horizon for make_lr: steps this run will take (matching the
+    reader's per-host ceil-div batch count) plus the restored optimizer
+    step for resumes — the restored count leaf already sits at the
+    checkpoint's step, so without the extension a resumed run would
+    clamp to the schedule floor immediately."""
+    per_host = -(-num_examples // num_hosts)
+    return -(-per_host // batch_size) * epochs + restored_step
+
+
+def resolve_checkpoint_schedule(requested: str, manifest: dict,
+                                log) -> str:
+    """The LR-schedule a loaded model must use: the checkpoint's (the
+    opt_state structure is fixed at first training). Warns when a CLI
+    request conflicts instead of silently dropping it."""
+    ckpt_schedule = manifest.get("lr_schedule", "constant")
+    if requested != ckpt_schedule:
+        log(f"--lr_schedule {requested!r} ignored: using the "
+            f"checkpoint's {ckpt_schedule!r} (the optimizer state "
+            "structure is fixed at first training)")
+    return ckpt_schedule
+
+
 def make_optimizer(learning_rate,
                    embedding_optimizer: str = "adafactor"
                    ) -> optax.GradientTransformation:

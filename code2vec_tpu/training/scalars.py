@@ -1,0 +1,36 @@
+"""Optional TensorBoard scalar streaming (--tensorboard <dir>).
+
+SURVEY.md §6 (metrics row): the reference logs loss/throughput lines to
+Python logging only; TensorBoard scalars are the optional TPU-build
+addition. Host-side and dependency-light: TensorFlow (installed for the
+baseline tooling) is imported lazily, only when a directory is given —
+the training path never touches TF otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+
+class ScalarWriter:
+    """No-op when constructed with dir=None, so call sites stay
+    unconditional. Writes one scalar per (tag, step) otherwise."""
+
+    def __init__(self, log_dir: Optional[str]):
+        self._writer = None
+        if log_dir:
+            import tensorflow as tf  # lazy: only with --tensorboard
+            self._writer = tf.summary.create_file_writer(log_dir)
+            self._tf = tf
+
+    def write(self, step: int, scalars: Mapping[str, float]) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default(step=step):
+            for tag, value in scalars.items():
+                self._tf.summary.scalar(tag, float(value))
+        self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

@@ -166,8 +166,10 @@ def test_cosine_lr_schedule_trains_and_resumes(dataset, tmp_path):
     model.save(ckpt)
 
     # resume restores schedule structure from the manifest even though
-    # the fresh config says constant
-    cfg2 = tiny_config(dataset, NUM_TRAIN_EPOCHS=1)
+    # the fresh config requests a DIFFERENT schedule (the manifest must
+    # win or the opt_state template won't match)
+    cfg2 = tiny_config(dataset, NUM_TRAIN_EPOCHS=1,
+                       LR_SCHEDULE="constant")
     cfg2.load_path = ckpt
     model2 = Code2VecModel(cfg2)
     assert cfg2.LR_SCHEDULE == "cosine"
@@ -183,3 +185,17 @@ def test_cosine_lr_schedule_trains_and_resumes(dataset, tmp_path):
     model3 = Code2VecModel(cfg3)
     eval_only = model3.evaluate()
     assert abs(eval_only.loss - after.loss) < 1e-4
+
+
+def test_tensorboard_scalars_written(dataset, tmp_path):
+    import os
+    tb = str(tmp_path / "tb")
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=2,
+                      NUM_BATCHES_TO_LOG_PROGRESS=2,
+                      SAVE_EVERY_EPOCHS=1, TENSORBOARD_DIR=tb)
+    model = Code2VecModel(cfg)
+    model.train()
+    events = []
+    for root, _d, files in os.walk(tb):
+        events.extend(f for f in files if "tfevents" in f)
+    assert events, f"no event files under {tb}"

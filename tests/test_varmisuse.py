@@ -172,8 +172,10 @@ def test_vm_cosine_schedule_trains(vm_dataset, tmp_path):
     m.save()
     res = m.evaluate(m._vm_path("train"))
     assert res.accuracy > 0.3
-    # eval-only load restores the schedule-bearing opt_state structure
-    cfg2 = vm_config(vm_dataset)
+    # eval-only load restores the schedule-bearing opt_state structure;
+    # request a conflicting schedule so the assert only passes when the
+    # manifest override actually runs
+    cfg2 = vm_config(vm_dataset, LR_SCHEDULE="constant")
     cfg2.train_data_path = None
     cfg2.load_path = str(tmp_path / "vmck")
     cfg2.test_data_path = "unused"
