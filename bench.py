@@ -85,22 +85,97 @@ def _measure_hbm_ceiling() -> float:
     return measure_hbm_ceiling()
 
 
+def _java_large_dims(encoder_type: str = "bag"):
+    from code2vec_tpu.models.encoder import ModelDims
+    return ModelDims(token_vocab_size=TOKEN_VOCAB,
+                     path_vocab_size=PATH_VOCAB,
+                     target_vocab_size=TARGET_VOCAB,
+                     embeddings_size=128, max_contexts=MAX_CONTEXTS,
+                     tables_dtype="bfloat16", encoder_type=encoder_type,
+                     xf_layers=2, xf_heads=4)
+
+
+def _device_batches(n: int = 4):
+    """n distinct uniform-random batches, placed on device once (the
+    rotation defeats any cross-step input caching; ids are uniform —
+    the worst case for the embedding gathers)."""
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        arrays = (
+            r.integers(0, TARGET_VOCAB, size=(BATCH,), dtype=np.int32),
+            r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                       dtype=np.int32),
+            r.integers(0, PATH_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                       dtype=np.int32),
+            r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+                       dtype=np.int32),
+            np.ones((BATCH, MAX_CONTEXTS), dtype=np.float32),
+            np.ones((BATCH,), dtype=np.float32))
+        out.append(tuple(jnp.asarray(a) for a in arrays))
+    return out
+
+
+def _slope_time(chain, state):
+    """Slope timing: two chain lengths, differenced — cancels the fixed
+    ~100 ms dispatch/sync overhead of the tunneled platform. `chain(n,
+    state) -> (seconds, state)` must hard-sync via a host transfer
+    (block_until_ready can return early on this platform)."""
+    _, state = chain(WARMUP_STEPS, state)
+    t1, state = chain(10, state)
+    t2, state = chain(10 + MEASURE_STEPS, state)
+    return (t2 - t1) / MEASURE_STEPS
+
+
+def _measure_fwd_bwd_floor():
+    """Forward+backward only (no optimizer), with the IDENTICAL math and
+    inputs as the full step (dropout on, same 4-batch rotation): the
+    zero-cost-optimizer ceiling of this config. The full step can't beat
+    B*C/floor_dt pc/s whatever the optimizer does — the floor is the
+    backward scatter-add of the dense embedding grads running at
+    random-access (not streaming) bandwidth; see BASELINE.md round-3
+    phase floors."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.models.encoder import init_params
+    from code2vec_tpu.training.steps import make_train_loss_fn
+
+    dims = _java_large_dims()
+    params = init_params(jax.random.PRNGKey(0), dims)
+    batches = _device_batches()
+    # the exact loss make_train_step differentiates — shared builder
+    loss_fn = make_train_loss_fn(
+        dims, use_sampled_softmax=True, num_sampled=NUM_SAMPLED,
+        compute_dtype=jnp.bfloat16,
+        use_pallas=jax.default_backend() == "tpu")
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def chain(n, rng):
+        t0 = time.perf_counter()
+        for i in range(n):
+            rng, k = jax.random.split(rng)
+            loss, _g = grad_fn(params, batches[i % len(batches)], k)
+        float(loss)
+        return time.perf_counter() - t0, rng
+
+    dt = _slope_time(chain, jax.random.PRNGKey(3))
+    return BATCH * MAX_CONTEXTS / dt
+
+
 def _measure_encoder(encoder_type: str):
     """Build the shipped train step for one encoder and time it.
     Returns (path_contexts_per_sec, ms_per_step, hbm_gbps)."""
     import jax
     import jax.numpy as jnp
 
-    from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.models.encoder import init_params
     from code2vec_tpu.training.optimizers import make_optimizer
     from code2vec_tpu.training.steps import make_train_step
 
-    dims = ModelDims(token_vocab_size=TOKEN_VOCAB,
-                     path_vocab_size=PATH_VOCAB,
-                     target_vocab_size=TARGET_VOCAB,
-                     embeddings_size=128, max_contexts=MAX_CONTEXTS,
-                     tables_dtype="bfloat16", encoder_type=encoder_type,
-                     xf_layers=2, xf_heads=4)
+    dims = _java_large_dims(encoder_type)
     params = init_params(jax.random.PRNGKey(0), dims)
     optimizer = make_optimizer(1e-3)  # shipped default: adafactor tables
     opt_state = optimizer.init(params)
@@ -109,47 +184,21 @@ def _measure_encoder(encoder_type: str):
                            num_sampled=NUM_SAMPLED,
                            compute_dtype=jnp.bfloat16,
                            use_pallas=jax.default_backend() == "tpu")
+    batches = _device_batches()
 
-    r = np.random.default_rng(0)
-
-    def batch_for(i):
-        labels = r.integers(0, TARGET_VOCAB, size=(BATCH,), dtype=np.int32)
-        src = r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
-                         dtype=np.int32)
-        pth = r.integers(0, PATH_VOCAB, size=(BATCH, MAX_CONTEXTS),
-                         dtype=np.int32)
-        dst = r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
-                         dtype=np.int32)
-        mask = np.ones((BATCH, MAX_CONTEXTS), dtype=np.float32)
-        weights = np.ones((BATCH,), dtype=np.float32)
-        return tuple(jnp.asarray(a) for a in
-                     (labels, src, pth, dst, mask, weights))
-
-    rng = jax.random.PRNGKey(1)
-    # a few distinct host batches so we're not timing a cached input
-    batches = [batch_for(i) for i in range(4)]
-
-    def chain(n, params, opt_state, rng):
-        """Run n chained steps; the donated-params chain serializes them,
-        so the final host transfer bounds the full computation."""
+    def chain(n, state):
+        """Run n chained steps; the donated-params chain serializes
+        them, so the final host transfer bounds the full computation."""
+        params, opt_state, rng = state
         t0 = time.perf_counter()
         for i in range(n):
             rng, k = jax.random.split(rng)
             params, opt_state, loss = step(params, opt_state,
                                            batches[i % len(batches)], k)
-        float(loss)  # hard sync; block_until_ready can return early on
-        # the tunneled axon platform
-        return time.perf_counter() - t0, params, opt_state, rng
+        float(loss)
+        return time.perf_counter() - t0, (params, opt_state, rng)
 
-    # slope timing: two chain lengths, differenced — cancels the fixed
-    # ~100 ms dispatch/sync overhead of the tunneled platform
-    _, params, opt_state, rng = chain(WARMUP_STEPS, params, opt_state,
-                                      rng)
-    t1, params, opt_state, rng = chain(10, params, opt_state, rng)
-    t2, params, opt_state, rng = chain(10 + MEASURE_STEPS, params,
-                                       opt_state, rng)
-    dt = (t2 - t1) / MEASURE_STEPS
-
+    dt = _slope_time(chain, (params, opt_state, jax.random.PRNGKey(1)))
     pc_per_sec = BATCH * MAX_CONTEXTS / dt
     return pc_per_sec, dt * 1e3, hbm_bytes / dt / 1e9
 
@@ -157,6 +206,7 @@ def _measure_encoder(encoder_type: str):
 def main() -> None:
     ceiling = _measure_hbm_ceiling()
     value, ms, hbm_gbps = _measure_encoder("bag")
+    floor = _measure_fwd_bwd_floor()
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
     print(json.dumps({
         "metric": "path-contexts/sec/chip",
@@ -178,6 +228,11 @@ def main() -> None:
         "hbm_gbps": round(hbm_gbps, 1),
         "hbm_ceiling_gbps": round(ceiling / 1e9, 1),
         "hbm_utilization": round(hbm_gbps / (ceiling / 1e9), 3),
+        # zero-cost-optimizer ceiling of this config (fwd+bwd only):
+        # the step is backward-scatter-bound, so value/floor close to 1
+        # means the optimizer is no longer the lever (BASELINE.md)
+        "fwd_bwd_floor_pc_per_sec": round(floor, 1),
+        "optimizer_efficiency": round(value / floor, 3),
         "transformer_pc_per_sec": round(xf_value, 1),
         "transformer_ms_per_step": round(xf_ms, 2),
         "transformer_hbm_gbps": round(xf_hbm, 1),

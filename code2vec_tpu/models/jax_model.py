@@ -286,28 +286,60 @@ class Code2VecModel(Code2VecModelBase):
         scalars.close()
         self.log("training done")
 
+    def _my_global_rows(self, local_batch_size: int) -> np.ndarray:
+        """Positions of THIS host's rows inside the global batch built by
+        shard_batch(process_local=True), discovered empirically (a tag
+        array round-trip) rather than assumed from device order; cached —
+        the layout is fixed for a given mesh and batch size."""
+        key = (local_batch_size,)
+        if getattr(self, "_row_map", None) is None:
+            self._row_map = {}
+        if key not in self._row_map:
+            tags = np.full((local_batch_size,), jax.process_index(),
+                           np.int32)
+            gtags = fetch_global(shard_batch(
+                self.mesh, (tags,), process_local=True)[0])
+            pos = np.nonzero(gtags == jax.process_index())[0]
+            assert len(pos) == local_batch_size
+            self._row_map[key] = pos
+        return self._row_map[key]
+
     # ---- evaluate (SURVEY.md §4.3) ----
     def evaluate(self) -> EvaluationResults:
         cfg = self.config
         assert cfg.test_data_path, "evaluate requires --test"
+        multi = jax.process_count() > 1
+        # Multi-host: each host parses and feeds a DISJOINT shard of the
+        # eval file (global eval batch = H x TEST_BATCH_SIZE), decodes
+        # only its own rows, and the metric partials are summed across
+        # hosts at the end — no redundant parsing, eval scales with H.
         reader = open_reader(
             cfg.test_data_path, self.vocabs, cfg.MAX_CONTEXTS,
-            cfg.TEST_BATCH_SIZE, shuffle=False, keep_strings=True)
+            cfg.TEST_BATCH_SIZE, shuffle=False, keep_strings=True,
+            host_shard=jax.process_index() if multi else 0,
+            num_host_shards=jax.process_count() if multi else 1)
         acc = MetricAccumulator(
             cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)
         for batch in reader:
-            # TODO(multi-host): every host parses and feeds the identical
-            # full eval batch (correct, but H× redundant host-side text
-            # parsing at pod scale); shard the file per host and allgather
-            # metric partials instead if eval ever dominates.
-            dev_batch = self._device_batch(batch, process_local=False)
+            dev_batch = self._device_batch(batch, process_local=multi)
             loss_sum, topk_ids, _ = self._eval_step(self.params, dev_batch)
             nv = batch.num_valid_examples
             names = (batch.target_strings[:nv] if batch.target_strings
                      else [self.vocabs.target_vocab.lookup_word(int(i))
                            for i in batch.target_index[:nv]])
-            words = self._ids_to_words(fetch_global(topk_ids)[:nv])
-            acc.update_batch(names, words, float(loss_sum))
+            topk_global = fetch_global(topk_ids)
+            if multi:
+                mine = self._my_global_rows(batch.target_index.shape[0])
+                topk_global = topk_global[mine]
+            words = self._ids_to_words(topk_global[:nv])
+            # loss_sum is computed over the GLOBAL batch (weights mask
+            # padding), identical on every host — count it once.
+            acc.update_batch(names, words,
+                             float(loss_sum)
+                             if (not multi or jax.process_index() == 0)
+                             else 0.0)
+        if multi:
+            acc.merge_across_hosts()
         return acc.results()
 
     # ---- predict raw extractor lines (SURVEY.md §4.4) ----

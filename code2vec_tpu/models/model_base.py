@@ -50,6 +50,25 @@ class MetricAccumulator:
             top_prediction = legal[0] if legal else ""
             self.subtoken_stats.update(original, top_prediction)
 
+    def merge_across_hosts(self) -> None:
+        """Sum this accumulator's partials with every other process's
+        (no-op single-process): the multi-host eval path shards the eval
+        file per host, so each accumulator holds one host's examples."""
+        from code2vec_tpu.parallel.distributed import allreduce_sum_hosts
+        vec = np.concatenate([
+            [self.num_examples, self.loss_sum,
+             self.subtoken_stats.true_positive,
+             self.subtoken_stats.false_positive,
+             self.subtoken_stats.false_negative],
+            self.topk_correct]).astype(np.float64)
+        total = allreduce_sum_hosts(vec)
+        self.num_examples = int(total[0])
+        self.loss_sum = float(total[1])
+        self.subtoken_stats.true_positive = int(total[2])
+        self.subtoken_stats.false_positive = int(total[3])
+        self.subtoken_stats.false_negative = int(total[4])
+        self.topk_correct = total[5:].astype(np.int64)
+
     def results(self) -> EvaluationResults:
         n = max(self.num_examples, 1)
         return EvaluationResults(

@@ -109,6 +109,35 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
     return True
 
 
+def allreduce_sum_hosts(vec):
+    """Sum a small host-side float64 vector across processes (identity
+    for single-process runs). Used to merge per-host evaluation metric
+    partials after a host-sharded eval pass.
+
+    Exactness: process_allgather round-trips through a device array,
+    which canonicalizes float64 -> float32 (x64 is off), so a value is
+    only transmitted exactly below 2^24. Each per-host value is split
+    into a 2^24 quotient and remainder before the gather and recombined
+    in float64 after, keeping integer metric counts exact up to 2^48
+    PER HOST (the cross-host summation itself happens host-side in
+    float64)."""
+    import numpy as np
+
+    import jax
+
+    vec = np.asarray(vec, np.float64)
+    if jax.process_count() == 1:
+        return vec
+    from jax.experimental import multihost_utils
+    SPLIT = float(1 << 24)
+    hi = np.floor(vec / SPLIT)
+    lo = vec - hi * SPLIT
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.stack([hi, lo]).astype(np.float32), tiled=False),
+        np.float64)  # [H, 2, n]
+    return (gathered[:, 0] * SPLIT + gathered[:, 1]).sum(axis=0)
+
+
 def fetch_global(x):
     """Bring a (possibly non-fully-addressable) global array to the host
     as numpy, identical on every process.

@@ -33,19 +33,20 @@ def _weighted_mean(values: jax.Array, weights: jax.Array) -> jax.Array:
     return jnp.sum(values * weights) / denom
 
 
-def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
-                    *, use_sampled_softmax: bool = False,
-                    num_sampled: int = 4096,
-                    compute_dtype=jnp.float32,
-                    use_pallas: bool = False) -> Callable:
-    """Returns jitted `step(params, opt_state, batch, rng) ->
-    (params, opt_state, loss)` where batch is a 6-tuple of arrays
-    (labels [B], src/path/dst ids [B, C], mask [B, C],
-    example_weights [B])."""
-
+def make_train_loss_fn(dims: ModelDims, *,
+                       use_sampled_softmax: bool = False,
+                       num_sampled: int = 4096,
+                       compute_dtype=jnp.float32,
+                       use_pallas: bool = False) -> Callable:
+    """The training-time loss `loss_fn(params, batch, rng)` (dropout on,
+    sampled or full softmax). Single source of truth: make_train_step
+    differentiates exactly this, and bench.py's fwd+bwd roofline floor
+    measures exactly this — the two MUST share it or the floor silently
+    measures different math than the step."""
     encode = get_encode_fn(dims)
 
-    def loss_fn(params, labels, src, pth, dst, mask, weights, rng):
+    def loss_fn(params, batch, rng):
+        labels, src, pth, dst, mask, weights = batch
         drop_rng, sample_rng = jax.random.split(rng)
         code, _attn = encode(
             params, src, pth, dst, mask, dropout_rng=drop_rng,
@@ -63,11 +64,27 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
             loss = _weighted_mean(ce, weights)
         return loss
 
+    return loss_fn
+
+
+def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
+                    *, use_sampled_softmax: bool = False,
+                    num_sampled: int = 4096,
+                    compute_dtype=jnp.float32,
+                    use_pallas: bool = False) -> Callable:
+    """Returns jitted `step(params, opt_state, batch, rng) ->
+    (params, opt_state, loss)` where batch is a 6-tuple of arrays
+    (labels [B], src/path/dst ids [B, C], mask [B, C],
+    example_weights [B])."""
+
+    loss_fn = make_train_loss_fn(
+        dims, use_sampled_softmax=use_sampled_softmax,
+        num_sampled=num_sampled, compute_dtype=compute_dtype,
+        use_pallas=use_pallas)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
-        labels, src, pth, dst, mask, weights = batch
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, labels, src, pth, dst, mask, weights, rng)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

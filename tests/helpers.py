@@ -84,3 +84,22 @@ def build_tiny_dataset(tmpdir: str, n_train: int = 256, n_val: int = 32,
 def load_tiny_vocabs(prefix: str) -> Code2VecVocabs:
     return Code2VecVocabs.load_from_dict_file(
         prefix + ".dict.c2v", 1000, 1000, 1000)
+
+
+def sharded_eval_setup(dir_path: str):
+    """The (dataset, Config) pair shared by the 2-process sharded-eval
+    worker (tests/mp_worker.py) and its single-process oracle
+    (tests/test_multihost.py) — one definition, so the comparison can
+    never drift via config edits to only one side."""
+    from code2vec_tpu.config import Config
+
+    prefix = build_tiny_dataset(dir_path, n_train=48, n_val=8, n_test=8,
+                                max_contexts=16)
+    cfg = Config(MAX_CONTEXTS=16, MAX_TOKEN_VOCAB_SIZE=1000,
+                 MAX_PATH_VOCAB_SIZE=1000, MAX_TARGET_VOCAB_SIZE=1000,
+                 DEFAULT_EMBEDDINGS_SIZE=16, TRAIN_BATCH_SIZE=16,
+                 TEST_BATCH_SIZE=8, USE_BF16=False,
+                 LR_SCHEDULE="constant")
+    cfg.train_data_path = prefix
+    cfg.test_data_path = prefix + ".train.c2v"
+    return cfg

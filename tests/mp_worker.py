@@ -92,10 +92,27 @@ def main() -> None:
 
     checksum = float(sum(jnp.sum(fetch_global(v).astype(np.float64))
                          for v in params.values()))
+
+    # --- model-level SHARDED evaluate: each host parses a disjoint shard
+    # of the eval file; metric partials allreduce at the end
+    # (jax_model.evaluate multi-host path) ---
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from helpers import sharded_eval_setup
+    ds_dir = os.path.join(out_dir, f"ds{pid}")
+    os.makedirs(ds_dir, exist_ok=True)
+    # deterministic build: both processes create identical content;
+    # config shared with the single-process oracle via helpers
+    cfg = sharded_eval_setup(ds_dir)
+    model = Code2VecModel(cfg)
+    eval_res = model.evaluate()
+
     np.savez(os.path.join(out_dir, f"proc{pid}.npz"),
              loss=float(loss), checksum=checksum,
              restored_checksum=restored_checksum,
-             eval_loss=float(loss_sum), topk=np.asarray(topk_host))
+             eval_loss=float(loss_sum), topk=np.asarray(topk_host),
+             m_eval_loss=eval_res.loss,
+             m_eval_top1=eval_res.topk_acc[0],
+             m_eval_f1=eval_res.subtoken_f1)
 
 
 if __name__ == "__main__":

@@ -207,3 +207,24 @@ def test_host_shard_readers_emit_aligned_batch_counts(tmp_path):
         assert counts[0] == counts[1] == 2, (use_binary, counts)
         assert valids[0] == [8, 1], (use_binary, valids)
         assert valids[1] == [8, 0], (use_binary, valids)
+
+
+def test_sharded_eval_matches_single_process(two_process_results,
+                                             tmp_path):
+    """evaluate() on 2 hosts shards the eval file per host and merges
+    metric partials; the result must equal a single-process evaluate of
+    the same model over the same data."""
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from helpers import sharded_eval_setup
+
+    oracle = Code2VecModel(sharded_eval_setup(str(tmp_path))).evaluate()
+
+    r0, r1 = two_process_results[0], two_process_results[1]
+    # both hosts report the identical merged metrics
+    for k in ("m_eval_loss", "m_eval_top1", "m_eval_f1"):
+        np.testing.assert_allclose(r0[k], r1[k], rtol=1e-6, err_msg=k)
+    np.testing.assert_allclose(r0["m_eval_loss"], oracle.loss, rtol=1e-4)
+    np.testing.assert_allclose(r0["m_eval_top1"], oracle.topk_acc[0],
+                               atol=1e-6)
+    np.testing.assert_allclose(r0["m_eval_f1"], oracle.subtoken_f1,
+                               atol=1e-6)

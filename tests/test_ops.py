@@ -107,3 +107,33 @@ def test_sampled_softmax_padded_examples_excluded():
         jax.random.PRNGKey(0), S, example_weights=jnp.asarray(w_half))
     np.testing.assert_allclose(float(loss_half), float(loss_half2),
                                rtol=1e-5)
+
+
+def test_make_lr_and_horizon_helpers():
+    import optax
+
+    from code2vec_tpu.training.optimizers import (make_lr,
+                                                  resolve_checkpoint_schedule,
+                                                  schedule_total_steps)
+    assert make_lr(1e-3) == 1e-3
+    sched = make_lr(1e-3, "cosine", 100)
+    assert abs(float(sched(0)) - 1e-3) < 1e-9
+    # decays to alpha=0.1 of peak at the horizon, clamps past it
+    assert abs(float(sched(100)) - 1e-4) < 1e-9
+    assert abs(float(sched(500)) - 1e-4) < 1e-9
+    lin = make_lr(2e-3, "linear", 10)
+    assert abs(float(lin(10)) - 2e-4) < 1e-9
+
+    # horizon: per-host ceil-div batches times epochs, plus resume offset
+    assert schedule_total_steps(100, 32, 2) == 8  # ceil(100/32)=4 *2
+    assert schedule_total_steps(100, 32, 2, num_hosts=2) == 4
+    assert schedule_total_steps(100, 32, 2, restored_step=7) == 15
+
+    msgs = []
+    assert resolve_checkpoint_schedule(
+        "cosine", {"lr_schedule": "constant"}, msgs.append) == "constant"
+    assert msgs and "ignored" in msgs[0]
+    msgs.clear()
+    assert resolve_checkpoint_schedule(
+        "cosine", {"lr_schedule": "cosine"}, msgs.append) == "cosine"
+    assert not msgs
