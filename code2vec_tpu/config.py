@@ -62,6 +62,8 @@ class Config:
     MESH_DATA_AXIS: int = 0   # 0 → use all devices on the data axis
     MESH_MODEL_AXIS: int = 1  # model-parallel degree for sharded vocab tables
     MESH_CONTEXT_AXIS: int = 1  # context-parallel degree (transformer)
+    MESH_DCN_AXIS: int = 1    # multi-slice data axis (batch shards over
+    #                           dcn x data; cross-slice psum rides DCN)
     USE_BF16: bool = True     # compute in bfloat16 on the MXU, params f32
     # Touched-rows-only (lazy) Adam for the vocab tables. Measured on one
     # v5e chip at java-large scale: row-granular scatter/gather runs at
@@ -252,6 +254,8 @@ class Config:
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--mesh_context", dest="mesh_context", type=int,
                        default=None)
+        p.add_argument("--mesh_dcn", dest="mesh_dcn", type=int,
+                       default=None)
         p.add_argument("--seed", dest="seed", type=int, default=None)
         p.add_argument("--dist_coordinator", dest="dist_coordinator",
                        default=None,
@@ -327,6 +331,8 @@ class Config:
             cfg.MESH_MODEL_AXIS = ns.mesh_model
         if ns.mesh_context is not None:
             cfg.MESH_CONTEXT_AXIS = ns.mesh_context
+        if ns.mesh_dcn is not None:
+            cfg.MESH_DCN_AXIS = ns.mesh_dcn
         if ns.seed is not None:
             cfg.SEED = ns.seed
         cfg.DIST_COORDINATOR = ns.dist_coordinator

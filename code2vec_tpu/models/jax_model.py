@@ -26,7 +26,7 @@ from code2vec_tpu.data.reader import (BatchTensors, _pad_batch, open_reader,
 from code2vec_tpu.models.encoder import ModelDims, init_params
 from code2vec_tpu.models.model_base import Code2VecModelBase, MetricAccumulator
 from code2vec_tpu.parallel.distributed import fetch_global
-from code2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, make_mesh
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
@@ -53,9 +53,10 @@ class Code2VecModel(Code2VecModelBase):
         self.mesh = None
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
         ctx_axis = max(1, cfg.MESH_CONTEXT_AXIS)
-        if n_dev > 1 or model_axis > 1 or ctx_axis > 1:
+        dcn_axis = max(1, cfg.MESH_DCN_AXIS)
+        if n_dev > 1 or model_axis > 1 or ctx_axis > 1 or dcn_axis > 1:
             self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis,
-                                  ctx_axis)
+                                  ctx_axis, dcn=dcn_axis)
         self.shard_contexts = ctx_axis > 1
 
         if cfg.is_loading:
@@ -357,7 +358,8 @@ class Code2VecModel(Code2VecModelBase):
         padded_n = max(1, 1 << (n - 1).bit_length())
         if self.mesh is not None:
             # batch dim must divide the data axis to shard over the mesh
-            dax = self.mesh.shape[DATA_AXIS]
+            # batch shards over ('dcn','data') jointly
+            dax = self.mesh.shape[DATA_AXIS] * self.mesh.shape[DCN_AXIS]
             padded_n = -(-padded_n // dax) * dax
         weights = np.zeros((padded_n,), dtype=np.float32)
         weights[:n] = 1.0

@@ -19,7 +19,7 @@ from code2vec_tpu.data.vm_reader import (VMTextReader, build_vm_vocabs)
 from code2vec_tpu.models.encoder import ModelDims
 from code2vec_tpu.models.varmisuse import init_vm_params
 from code2vec_tpu.parallel.distributed import fetch_global
-from code2vec_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, make_mesh
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
@@ -52,8 +52,10 @@ class VarMisuseModel:
         n_dev = len(jax.devices())
         self.mesh = None
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
-        if n_dev > 1 or model_axis > 1:
-            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis)
+        dcn_axis = max(1, cfg.MESH_DCN_AXIS)
+        if n_dev > 1 or model_axis > 1 or dcn_axis > 1:
+            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis,
+                                  dcn=dcn_axis)
 
         if cfg.is_loading:
             self.dims = ckpt.load_dims(cfg.load_path)
@@ -212,7 +214,7 @@ class VarMisuseModel:
         batch = [labels, src, pth, dst, mask, cand, cand_mask, weights]
         if self.mesh is not None:
             # pad the batch dim to divide the data axis
-            dax = self.mesh.shape[DATA_AXIS]
+            dax = self.mesh.shape[DATA_AXIS] * self.mesh.shape[DCN_AXIS]
             padded = -(-n // dax) * dax
             if padded != n:
                 for i, a in enumerate(batch):

@@ -1,11 +1,12 @@
 """Device-mesh construction.
 
 SURVEY.md §3.3: the reference is single-device; the TPU framework scales by
-SPMD over a `jax.sharding.Mesh` — the batch rides the 'data' axis
-(gradient allreduce over ICI, replacing any NCCL analog) and the large
-vocab tables shard over the 'model' axis. Axes are named, so a future
-multi-slice ('dcn', 'data', 'model') mesh is a pure relabeling
-(SURVEY.md §3.3 "keep mesh axes abstract").
+SPMD over a `jax.sharding.Mesh` — the batch rides the composite
+('dcn', 'data') axes (within-slice gradient allreduce over ICI, final
+cross-slice psum over DCN — replacing any NCCL analog), the large vocab
+tables shard over 'model', and the transformer's context dim can shard
+over 'ctx'. All four axes exist on every mesh; unused ones sit at size 1
+as no-ops (SURVEY.md §3.3 "keep mesh axes abstract").
 """
 
 from __future__ import annotations
@@ -16,14 +17,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+DCN_AXIS = "dcn"
 DATA_AXIS = "data"
 CONTEXT_AXIS = "ctx"
 MODEL_AXIS = "model"
 
 
 def make_mesh(data: int = 0, model: int = 1, context: int = 1,
+              dcn: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ('data', 'ctx', 'model') mesh.
+    """Build a ('dcn', 'data', 'ctx', 'model') mesh.
 
     data=0 means "use all remaining devices on the data axis". For
     multi-host runs `jax.devices()` already spans hosts, so the same call
@@ -35,24 +38,35 @@ def make_mesh(data: int = 0, model: int = 1, context: int = 1,
     (SURVEY.md §6 long-context row): sharding the MAX_CONTEXTS dim of
     [B, C, D] activations over it makes XLA insert the attention
     all-gathers over ICI — tested in tests/test_transformer.py.
+
+    The leading 'dcn' axis (default size 1, a no-op) is the multi-slice
+    data axis (SURVEY.md §3.3: "DCN axis reserved for multi-slice"): the
+    batch shards over ('dcn', 'data') jointly, so within a slice the
+    gradient reduction rides ICI and only the final cross-slice psum
+    crosses DCN. Slice count must be the OUTERMOST reshape dim so each
+    slice's devices stay contiguous — on real multi-slice hardware build
+    the device array with jax.experimental.mesh_utils.
+    create_hybrid_device_mesh and pass it via `devices`; the virtual-CPU
+    tests exercise the same axis layout and collectives.
     """
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
     model = max(1, model)
     context = max(1, context)
+    dcn = max(1, dcn)
     if data <= 0:
-        if n % (model * context) != 0:
+        if n % (dcn * model * context) != 0:
             raise ValueError(
-                f"{n} devices not divisible by model*ctx="
-                f"{model * context}")
-        data = n // (model * context)
-    need = data * model * context
+                f"{n} devices not divisible by dcn*model*ctx="
+                f"{dcn * model * context}")
+        data = n // (dcn * model * context)
+    need = dcn * data * model * context
     if need != n:
         # Allow a mesh over a subset only when explicitly requested.
         if need > n:
             raise ValueError(
-                f"mesh {data}x{context}x{model} needs {need} devices, "
-                f"have {n}")
+                f"mesh {dcn}x{data}x{context}x{model} needs {need} "
+                f"devices, have {n}")
         devs = devs[:need]
-    arr = np.asarray(devs).reshape(data, context, model)
-    return Mesh(arr, (DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))
+    arr = np.asarray(devs).reshape(dcn, data, context, model)
+    return Mesh(arr, (DCN_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))

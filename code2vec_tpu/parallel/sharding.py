@@ -21,7 +21,8 @@ from typing import Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from code2vec_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+from code2vec_tpu.parallel.mesh import (CONTEXT_AXIS, DATA_AXIS, DCN_AXIS,
+                                        MODEL_AXIS)
 
 
 def param_pspecs() -> Dict[str, P]:
@@ -39,14 +40,17 @@ def param_pspecs() -> Dict[str, P]:
 
 
 def batch_pspec() -> P:
-    """Leading (batch) dim over 'data'; everything else replicated."""
-    return P(DATA_AXIS)
+    """Leading (batch) dim over ('dcn', 'data') jointly — within a
+    slice the gradient reduction rides ICI, only the final cross-slice
+    psum crosses DCN (a no-op composite at dcn=1); everything else
+    replicated."""
+    return P((DCN_AXIS, DATA_AXIS))
 
 
 def context_batch_pspec() -> P:
     """[B, C] tensors with the context dim sharded over 'ctx' — the
     sequence/context-parallel layout for the transformer encoder."""
-    return P(DATA_AXIS, CONTEXT_AXIS)
+    return P((DCN_AXIS, DATA_AXIS), CONTEXT_AXIS)
 
 
 def shard_params(mesh: Mesh, params) -> Dict[str, jax.Array]:

@@ -32,12 +32,18 @@ def _batch(rng, b=16):
 
 def test_mesh_shapes():
     mesh = make_mesh(0, 2)
-    assert mesh.shape == {"data": 4, "ctx": 1, "model": 2}
+    assert mesh.shape == {"dcn": 1, "data": 4, "ctx": 1, "model": 2}
     with pytest.raises(ValueError):
         make_mesh(3, 3)
+    mesh2 = make_mesh(0, 2, dcn=2)
+    assert mesh2.shape == {"dcn": 2, "data": 2, "ctx": 1, "model": 2}
 
 
-def test_sharded_train_step_matches_single_device():
+@pytest.mark.parametrize("mesh_kwargs", [
+    dict(),          # DP x TP: batch over 'data', tables over 'model'
+    dict(dcn=2),     # multi-slice: batch over composite ('dcn','data')
+], ids=["data-model", "dcn-data-model"])
+def test_sharded_train_step_matches_single_device(mesh_kwargs):
     assert len(jax.devices()) == 8
     params = init_params(jax.random.PRNGKey(0), DIMS)
     opt = optax.adam(0.01)
@@ -51,8 +57,8 @@ def test_sharded_train_step_matches_single_device():
         jax.tree_util.tree_map(jnp.copy, params), opt.init(params),
         tuple(jnp.asarray(a) for a in batch), rng)
 
-    # sharded run: params over ('model',), batch over ('data',)
-    mesh = make_mesh(0, 2)
+    # sharded run: numerics must be layout-invariant
+    mesh = make_mesh(0, 2, **mesh_kwargs)
     sp = shard_params(mesh, params)
     so = shard_opt_state(mesh, opt_state, sp)
     sb = shard_batch(mesh, batch)

@@ -113,7 +113,8 @@ def test_context_parallel_matches_single_device():
                         opt.init(params), batch, rng)
 
     mesh = make_mesh(2, 2, 2)
-    assert dict(mesh.shape) == {"data": 2, "ctx": 2, "model": 2}
+    assert dict(mesh.shape) == {"dcn": 1, "data": 2, "ctx": 2,
+                                "model": 2}
     sp = shard_params(mesh, params)
     so = shard_opt_state(mesh, opt.init(sp), sp)
     sb = shard_batch(mesh, batch, shard_contexts=True)
