@@ -188,15 +188,28 @@ class VarMisuseModel:
         cfg = self.config
         path = split_path or cfg.test_data_path
         assert path, "evaluate requires --test"
+        multi = jax.process_count() > 1
+        # Multi-host: each host parses a DISJOINT shard (global eval
+        # batch = H x TEST_BATCH_SIZE). The eval step returns GLOBAL
+        # weighted sums (identical on every host), so only the local
+        # example count needs cross-host merging.
         reader = VMTextReader(path, self.vocabs, cfg.MAX_CONTEXTS,
-                              cfg.MAX_CANDIDATES, cfg.TEST_BATCH_SIZE)
+                              cfg.MAX_CANDIDATES, cfg.TEST_BATCH_SIZE,
+                              host_shard=jax.process_index() if multi
+                              else 0,
+                              num_host_shards=jax.process_count()
+                              if multi else 1)
         loss_sum = correct = total = 0.0
         for batch in reader:
-            dev_batch = self._device_batch(batch, process_local=False)
+            dev_batch = self._device_batch(batch, process_local=multi)
             ls, cs, _pred = self._eval_step(self.params, dev_batch)
             loss_sum += float(ls)
             correct += float(cs)
             total += batch.num_valid_examples
+        if multi:
+            from code2vec_tpu.parallel.distributed import \
+                allreduce_sum_hosts
+            total = float(allreduce_sum_hosts([total])[0])
         total = max(total, 1.0)
         return VMEvalResults(loss_sum / total, correct / total,
                              int(total))

@@ -43,11 +43,12 @@ def make_mesh(data: int = 0, model: int = 1, context: int = 1,
     data axis (SURVEY.md §3.3: "DCN axis reserved for multi-slice"): the
     batch shards over ('dcn', 'data') jointly, so within a slice the
     gradient reduction rides ICI and only the final cross-slice psum
-    crosses DCN. Slice count must be the OUTERMOST reshape dim so each
-    slice's devices stay contiguous — on real multi-slice hardware build
-    the device array with jax.experimental.mesh_utils.
-    create_hybrid_device_mesh and pass it via `devices`; the virtual-CPU
-    tests exercise the same axis layout and collectives.
+    crosses DCN. With dcn > 1 and no explicit `devices`, the device
+    array is built with mesh_utils.create_hybrid_device_mesh so each
+    slice's devices land contiguous on the 'dcn' axis (plain
+    jax.devices() order doesn't guarantee slice-majority); environments
+    without slice topology (the virtual-CPU tests) fall back to a plain
+    reshape, which exercises the same axis layout and collectives.
     """
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
@@ -68,5 +69,17 @@ def make_mesh(data: int = 0, model: int = 1, context: int = 1,
                 f"mesh {dcn}x{data}x{context}x{model} needs {need} "
                 f"devices, have {n}")
         devs = devs[:need]
+    axes = (DCN_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS)
+    if dcn > 1 and devices is None:
+        try:
+            from jax.experimental import mesh_utils
+            hybrid = mesh_utils.create_hybrid_device_mesh(
+                (data, context, model), (dcn, 1, 1))
+            return Mesh(hybrid.reshape(dcn, data, context, model), axes)
+        except Exception:
+            # no slice topology (e.g. virtual CPU devices): plain
+            # reshape keeps the axis layout; ICI/DCN distinction is
+            # moot without real slices
+            pass
     arr = np.asarray(devs).reshape(dcn, data, context, model)
-    return Mesh(arr, (DCN_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))
+    return Mesh(arr, axes)

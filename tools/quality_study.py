@@ -135,9 +135,11 @@ def main() -> None:
             with open(args.out, "a") as f:
                 f.write(json.dumps(r) + "\n")
 
-    print("\nvariant                    F1      top1    loss")
+    print("\nvariant                    B     lr      sched     F1      "
+          "top1    loss")
     for r in results:
-        print(f"{r['variant']:26s} {r['val_f1']:.4f}  "
+        print(f"{r['variant']:26s} {r['batch']:<5d} {r['lr']:<7g} "
+              f"{r['lr_schedule']:9s} {r['val_f1']:.4f}  "
               f"{r['val_top1']:.4f}  {r['val_loss']:.3f}")
 
 
