@@ -102,6 +102,10 @@ class Config:
     # Per-layer rematerialization (jax.checkpoint) for deep encoders —
     # required at CodeBERT depth (12 layers) to keep activations O(1).
     XF_REMAT: bool = False
+    # Ring attention over the ctx mesh axis (K/V rotate via ppermute;
+    # O(C/s) per-device attention memory). Only takes effect with
+    # --encoder transformer and --mesh_context > 1.
+    RING_ATTENTION: bool = False
 
     # ---- task head: "code2vec" (method-name prediction, reference
     # parity) or "varmisuse" (pointer-style variable-misuse repair,
@@ -242,6 +246,8 @@ class Config:
                        default=None)
         p.add_argument("--xf_remat", dest="xf_remat",
                        action="store_true")
+        p.add_argument("--ring_attention", dest="ring_attention",
+                       action="store_true")
         p.add_argument("--head", dest="head", default=None,
                        choices=["code2vec", "varmisuse"])
         p.add_argument("--max_candidates", dest="max_candidates",
@@ -316,6 +322,8 @@ class Config:
             cfg.XF_HEADS = ns.xf_heads
         if ns.xf_remat:
             cfg.XF_REMAT = True
+        if ns.ring_attention:
+            cfg.RING_ATTENTION = True
         if ns.head is not None:
             cfg.HEAD = ns.head
         cfg.HEAD_EXPLICIT = ns.head is not None

@@ -58,6 +58,12 @@ class ModelDims:
     # activation memory — required to fit CodeBERT-depth (12-layer)
     # encoders at B*C activation scale (SURVEY.md "HBM bandwidth" row).
     xf_remat: bool = False
+    # Ring attention over the 'ctx' mesh axis (ops/ring_attention.py):
+    # K/V stay sharded and rotate via ppermute instead of the XLA
+    # all-gather — O(C/s) per-device attention memory for long-context
+    # sequence parallelism. Takes effect only when the mesh's ctx axis
+    # is > 1 (numerically exact either way).
+    ring_attention: bool = False
 
     @property
     def context_vector_size(self) -> int:
@@ -133,15 +139,18 @@ def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
                           params["attention"], mask)
 
 
-def get_encode_fn(dims: ModelDims):
+def get_encode_fn(dims: ModelDims, mesh=None):
     """The encode callable for dims.encoder_type (same signature as
-    `encode`); the jitted steps in training/steps.py close over it."""
+    `encode`); the jitted steps in training/steps.py close over it.
+    `mesh` is only consumed by the transformer's ring-attention path
+    (dims.ring_attention with a ctx axis > 1)."""
     if dims.encoder_type == "transformer":
         import functools
 
         from code2vec_tpu.models.transformer_encoder import (
             encode_transformer)
-        return functools.partial(encode_transformer, dims=dims)
+        return functools.partial(encode_transformer, dims=dims,
+                                 mesh=mesh)
     return encode
 
 

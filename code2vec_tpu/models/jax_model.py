@@ -96,6 +96,7 @@ class Code2VecModel(Code2VecModelBase):
                 xf_layers=cfg.XF_LAYERS,
                 xf_heads=cfg.XF_HEADS,
                 xf_remat=cfg.XF_REMAT,
+                ring_attention=cfg.RING_ATTENTION,
             )
         from code2vec_tpu.training.optimizers import make_lr, make_optimizer
         # The schedule must match what the checkpoint's opt_state was
@@ -187,14 +188,15 @@ class Code2VecModel(Code2VecModelBase):
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
                 num_sampled=cfg.NUM_SAMPLED_CLASSES,
                 compute_dtype=self.compute_dtype,
-                use_pallas=self.use_pallas)
+                use_pallas=self.use_pallas, mesh=self.mesh)
         top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
         self._eval_step = make_eval_step(self.dims, top_k=top_k,
                                          compute_dtype=self.compute_dtype,
-                                         use_pallas=self.use_pallas)
+                                         use_pallas=self.use_pallas,
+                                         mesh=self.mesh)
         self._predict_step = make_predict_step(
             self.dims, top_k=top_k, compute_dtype=self.compute_dtype,
-            use_pallas=self.use_pallas)
+            use_pallas=self.use_pallas, mesh=self.mesh)
 
     # ---- vocabs: dataset dict when training, checkpoint sidecar when
     # loading (SURVEY.md §3.2 "Model checkpoint") ----
@@ -445,7 +447,8 @@ class Code2VecModel(Code2VecModelBase):
                              cfg.TEST_BATCH_SIZE, shuffle=False,
                              keep_strings=True)
         encode_step = make_encode_step(self.dims,
-                                       compute_dtype=self.compute_dtype)
+                                       compute_dtype=self.compute_dtype,
+                                       mesh=self.mesh)
         with open(dest_path, "w", encoding="utf-8") as f:
             for batch in reader:
                 dev_batch = self._device_batch(batch, process_local=False)

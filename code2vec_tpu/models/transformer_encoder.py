@@ -66,7 +66,8 @@ def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
 
 
 def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
-         log_mask: jax.Array, heads: int) -> jax.Array:
+         log_mask: jax.Array, heads: int,
+         ring_mesh=None) -> jax.Array:
     B, C, D = x.shape
     hd = D // heads
     proj = x @ qkv.astype(x.dtype)                     # [B, C, 3D]
@@ -76,10 +77,15 @@ def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
         return t.reshape(B, C, heads, hd).transpose(0, 2, 1, 3)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-    logits = logits / jnp.sqrt(float(hd)) + log_mask[:, None, None, :]
-    attn = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    if ring_mesh is not None:
+        from code2vec_tpu.ops.ring_attention import ring_attention
+        ctx = ring_attention(q, k, v, log_mask, ring_mesh)
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        logits = logits / jnp.sqrt(float(hd)) \
+            + log_mask[:, None, None, :]
+        attn = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, C, D)
     return ctx @ out.astype(x.dtype)
 
@@ -88,6 +94,7 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
                        path_ids: jax.Array, target_ids: jax.Array,
                        mask: jax.Array, *,
                        dims: ModelDims,
+                       mesh=None,
                        dropout_rng: Optional[jax.Array] = None,
                        dropout_keep_rate: float = 1.0,
                        compute_dtype=jnp.float32,
@@ -95,8 +102,15 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
                        ) -> Tuple[jax.Array, jax.Array]:
     """Same contract as encoder.encode: returns (code [B, D] in compute
     dtype, pool attention [B, C] f32). `use_pallas` accepted for
-    interface parity (the layers are MXU matmuls XLA already fuses)."""
+    interface parity (the layers are MXU matmuls XLA already fuses).
+    With dims.ring_attention and a mesh whose 'ctx' axis is > 1, the
+    self-attention runs as ring attention (K/V rotate via ppermute,
+    O(C/s) per-device memory) instead of relying on XLA's all-gather."""
     del use_pallas
+    from code2vec_tpu.parallel.mesh import CONTEXT_AXIS
+    ring_mesh = (mesh if (dims.ring_attention and mesh is not None
+                          and dict(mesh.shape).get(CONTEXT_AXIS, 1) > 1)
+                 else None)
     xf = params["xf"]
     emb = jnp.concatenate([
         jnp.take(params["token_emb"], source_ids, axis=0),
@@ -117,7 +131,7 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
     def layer_fn(x, layer):
         h = _rms_norm(x, layer["ln1_scale"])
         x = x + _mha(h, layer["qkv"], layer["out"], log_mask,
-                     dims.xf_heads)
+                     dims.xf_heads, ring_mesh=ring_mesh)
         h = _rms_norm(x, layer["ln2_scale"])
         h = jax.nn.gelu(h @ layer["mlp_up"].astype(compute_dtype))
         return x + h @ layer["mlp_down"].astype(compute_dtype)

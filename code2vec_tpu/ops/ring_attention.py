@@ -1,0 +1,90 @@
+"""Ring attention over the context-parallel mesh axis.
+
+Long-context design (SURVEY.md §6 long-context row; the driver brief
+lists ring/all-to-all sequence parallelism as first-class): with the
+context dim of [B, C, D] activations sharded over the 'ctx' axis, plain
+jit lets XLA insert an all-gather of K/V — O(C) memory per device.
+Ring attention instead keeps K/V sharded and rotates each shard around
+the ring with `ppermute` while accumulating the softmax in flash-style
+running form (running max m, normalizer l, weighted accumulator acc),
+so per-device memory stays O(C/s) and the transfers overlap compute on
+ICI. The bag-of-contexts model needs no causal mask — only the key-side
+padding log-mask, which rotates with its K/V shard.
+
+Numerically exact (not an approximation): the streamed softmax
+reproduces dense masked attention to float tolerance — verified against
+the dense oracle in tests/test_ring_attention.py, gradients included
+(autodiff goes through ppermute/scan natively).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from code2vec_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, DCN_AXIS
+
+
+def _ring_attention_local(q, k, v, log_mask, axis_name: str):
+    """Per-device body (runs under shard_map): q,k,v [B, H, Cl, hd]
+    local shards; log_mask [B, Cl] key-side additive mask for the LOCAL
+    key shard. Returns attention output [B, H, Cl, hd] for the local
+    queries, attending over ALL keys via s ring rotations."""
+    s = jax.lax.axis_size(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    ring = [(i, (i + 1) % s) for i in range(s)]
+
+    def block(q, k, v, mask):
+        # [B, H, Cq, Ck] logits in f32 for a stable running softmax
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        return logits * scale + mask[:, None, None, :]
+
+    def accumulate(m, l, acc, k, v, mask):
+        logits = block(q, k, v, mask)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+        return m_new, l, acc
+
+    def step(carry, _):
+        m, l, acc, k, v, mask = carry
+        # rotate FIRST, then accumulate: the local (hop-0) block is
+        # consumed before the scan, so no dead final rotation is issued
+        # (3 wasted ICI transfers per layer otherwise)
+        k = jax.lax.ppermute(k, axis_name, ring)
+        v = jax.lax.ppermute(v, axis_name, ring)
+        mask = jax.lax.ppermute(mask, axis_name, ring)
+        m, l, acc = accumulate(m, l, acc, k, v, mask)
+        return (m, l, acc, k, v, mask), None
+
+    B, H, Cq, hd = q.shape
+    m0 = jnp.full((B, H, Cq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Cq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Cq, hd), jnp.float32)
+    m, l, acc = accumulate(m0, l0, acc0, k, v, log_mask)  # local block
+    (m, l, acc, _, _, _), _ = jax.lax.scan(
+        step, (m, l, acc, k, v, log_mask), None, length=s - 1)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, log_mask, mesh, *,
+                   axis_name: str = CONTEXT_AXIS):
+    """Masked multi-head attention with the context dim sharded over
+    `axis_name` of `mesh`. q/k/v: [B, H, C, hd] (C globally sharded over
+    the ctx axis); log_mask: [B, C] additive key mask. Batch rides the
+    composite ('dcn','data') axes as everywhere else."""
+    qkv_spec = P((DCN_AXIS, DATA_AXIS), None, axis_name, None)
+    mask_spec = P((DCN_AXIS, DATA_AXIS), axis_name)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False)
+    return fn(q, k, v, log_mask)

@@ -74,12 +74,20 @@ def make_mesh(data: int = 0, model: int = 1, context: int = 1,
         try:
             from jax.experimental import mesh_utils
             hybrid = mesh_utils.create_hybrid_device_mesh(
-                (data, context, model), (dcn, 1, 1))
+                (data, context, model), (dcn, 1, 1), devices=devs)
             return Mesh(hybrid.reshape(dcn, data, context, model), axes)
-        except Exception:
-            # no slice topology (e.g. virtual CPU devices): plain
-            # reshape keeps the axis layout; ICI/DCN distinction is
-            # moot without real slices
-            pass
+        except Exception as e:
+            # Expected only where devices carry no slice topology (the
+            # virtual CPU mesh in tests). On real multi-slice hardware
+            # the fallback reshape may interleave slices on the 'dcn'
+            # axis and route per-step allreduces over DCN — loud
+            # warning, not silence, so the throughput regression is
+            # diagnosable.
+            import logging
+            logging.getLogger("code2vec-tpu").warning(
+                "hybrid (slice-aware) mesh construction failed (%s); "
+                "falling back to jax.devices() order — on real "
+                "multi-slice hardware verify slice contiguity or pass "
+                "an explicit device array", e)
     arr = np.asarray(devs).reshape(dcn, data, context, model)
     return Mesh(arr, axes)

@@ -65,6 +65,7 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
         "xf_heads": dims.xf_heads,
         "xf_mlp_ratio": dims.xf_mlp_ratio,
         "xf_remat": dims.xf_remat,
+        "ring_attention": dims.ring_attention,
         "step": step,
     }
     if extra_manifest:
@@ -105,6 +106,7 @@ def load_dims(ckpt_dir: str) -> ModelDims:
         xf_heads=m.get("xf_heads", 4),
         xf_mlp_ratio=m.get("xf_mlp_ratio", 4),
         xf_remat=m.get("xf_remat", False),
+        ring_attention=m.get("ring_attention", False),
     )
 
 

@@ -37,13 +37,14 @@ def make_train_loss_fn(dims: ModelDims, *,
                        use_sampled_softmax: bool = False,
                        num_sampled: int = 4096,
                        compute_dtype=jnp.float32,
-                       use_pallas: bool = False) -> Callable:
+                       use_pallas: bool = False,
+                       mesh=None) -> Callable:
     """The training-time loss `loss_fn(params, batch, rng)` (dropout on,
     sampled or full softmax). Single source of truth: make_train_step
     differentiates exactly this, and bench.py's fwd+bwd roofline floor
     measures exactly this — the two MUST share it or the floor silently
     measures different math than the step."""
-    encode = get_encode_fn(dims)
+    encode = get_encode_fn(dims, mesh)
 
     def loss_fn(params, batch, rng):
         labels, src, pth, dst, mask, weights = batch
@@ -71,7 +72,8 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
                     *, use_sampled_softmax: bool = False,
                     num_sampled: int = 4096,
                     compute_dtype=jnp.float32,
-                    use_pallas: bool = False) -> Callable:
+                    use_pallas: bool = False,
+                    mesh=None) -> Callable:
     """Returns jitted `step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)` where batch is a 6-tuple of arrays
     (labels [B], src/path/dst ids [B, C], mask [B, C],
@@ -80,7 +82,7 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
     loss_fn = make_train_loss_fn(
         dims, use_sampled_softmax=use_sampled_softmax,
         num_sampled=num_sampled, compute_dtype=compute_dtype,
-        use_pallas=use_pallas)
+        use_pallas=use_pallas, mesh=mesh)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
@@ -94,10 +96,11 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
 
 def make_eval_step(dims: ModelDims, *, top_k: int = 10,
                    compute_dtype=jnp.float32,
-                   use_pallas: bool = False) -> Callable:
+                   use_pallas: bool = False,
+                   mesh=None) -> Callable:
     """Returns jitted `step(params, batch) -> (loss_sum, topk_ids,
     topk_probs)`; no dropout (SURVEY.md §4.3)."""
-    encode = get_encode_fn(dims)
+    encode = get_encode_fn(dims, mesh)
 
     @jax.jit
     def step(params, batch):
@@ -117,11 +120,12 @@ def make_eval_step(dims: ModelDims, *, top_k: int = 10,
 
 def make_encode_step(dims: ModelDims, *,
                      compute_dtype=jnp.float32,
-                     use_pallas: bool = False) -> Callable:
+                     use_pallas: bool = False,
+                     mesh=None) -> Callable:
     """Returns jitted `step(params, batch) -> code_vectors [B, D] f32` —
     encoder only, no [B, V] logits matmul. Used by --export_code_vectors
     over a whole test split, where top-k/softmax would be wasted FLOPs."""
-    encode = get_encode_fn(dims)
+    encode = get_encode_fn(dims, mesh)
 
     @jax.jit
     def step(params, batch):
@@ -136,12 +140,13 @@ def make_encode_step(dims: ModelDims, *,
 
 def make_predict_step(dims: ModelDims, *, top_k: int = 10,
                       compute_dtype=jnp.float32,
-                      use_pallas: bool = False) -> Callable:
+                      use_pallas: bool = False,
+                      mesh=None) -> Callable:
     """Returns jitted `step(params, batch) -> (topk_ids, topk_probs,
     attention, code_vectors)` — the predict graph additionally surfaces
     per-context attention and the code vector (SURVEY.md §4.4,
     interpretability output + --export_code_vectors)."""
-    encode = get_encode_fn(dims)
+    encode = get_encode_fn(dims, mesh)
 
     @jax.jit
     def step(params, batch):

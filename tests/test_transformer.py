@@ -89,9 +89,14 @@ def test_transformer_train_step_learns():
     assert np.isfinite(losses[-1])
 
 
-def test_context_parallel_matches_single_device():
-    """(data=2, ctx=2, model=2) mesh, context dim sharded: XLA inserts
-    the attention collectives; numerics must match one device."""
+@pytest.mark.parametrize("ring", [False, True],
+                         ids=["xla-allgather", "ring-attention"])
+def test_context_parallel_matches_single_device(ring):
+    """(data=2, ctx=2, model=2) mesh, context dim sharded — via XLA's
+    inserted collectives or explicit ring attention (K/V ppermute
+    rotation): numerics must match one device either way."""
+    import dataclasses
+
     from code2vec_tpu.parallel.mesh import make_mesh
     from code2vec_tpu.parallel.sharding import (shard_batch,
                                                 shard_opt_state,
@@ -115,12 +120,13 @@ def test_context_parallel_matches_single_device():
     mesh = make_mesh(2, 2, 2)
     assert dict(mesh.shape) == {"dcn": 1, "data": 2, "ctx": 2,
                                 "model": 2}
+    dims2 = dataclasses.replace(dims, ring_attention=ring)
     sp = shard_params(mesh, params)
     so = shard_opt_state(mesh, opt.init(sp), sp)
     sb = shard_batch(mesh, batch, shard_contexts=True)
     # [B, C] tensors really are context-sharded
     assert "ctx" in str(sb[1].sharding.spec)
-    step2 = make_train_step(dims, opt)
+    step2 = make_train_step(dims2, opt, mesh=mesh if ring else None)
     p2, _, loss2 = step2(sp, so, sb, rng)
 
     np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
