@@ -65,3 +65,16 @@ def test_ring_handles_fully_padded_shard():
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
                                atol=1e-5)
     assert np.isfinite(np.asarray(out_ring)).all()
+
+
+def test_ring_on_combined_dcn_ctx_mesh():
+    """Ring attention must also be exact when the batch rides the
+    composite ('dcn','data') axes alongside a ctx ring."""
+    q, k, v, mask = _inputs()
+    mesh = make_mesh(1, 2, 2, dcn=2)
+    assert dict(mesh.shape) == {"dcn": 2, "data": 1, "ctx": 2,
+                                "model": 2}
+    out_ref = dense_oracle(q, k, v, mask)
+    out_ring = ring_attention(q, k, v, mask, mesh)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               atol=1e-5)
