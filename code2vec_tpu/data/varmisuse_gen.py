@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import random
 import re
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from code2vec_tpu.models.varmisuse import SLOT_TOKEN
 

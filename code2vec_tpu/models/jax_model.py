@@ -16,7 +16,6 @@ from typing import Iterable, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from code2vec_tpu.common import (EvaluationResults, MethodPredictionResults,
                                  SpecialVocabWords)

@@ -15,18 +15,17 @@ replaced by ~1 GB of gather/scatter on touched rows.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from code2vec_tpu.models.encoder import ModelDims, logits_vs_table
+from code2vec_tpu.models.encoder import ModelDims
 from code2vec_tpu.ops.attention import attention_pool
 from code2vec_tpu.ops.sampled_softmax import (
-    _log_expected_count, log_uniform_sample, sampled_softmax_from_gathered)
-from code2vec_tpu.training.sparse_adam import (RowAdamState, init_row_adam,
+    _log_expected_count, log_uniform_sample)
+from code2vec_tpu.training.sparse_adam import (init_row_adam,
                                                row_adam_update)
 
 

@@ -17,7 +17,7 @@ over 'model' are inserted by XLA, not hand-written collectives.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from code2vec_tpu.config import Config
 from code2vec_tpu.models.encoder import ModelDims, init_params
 from code2vec_tpu.models.jax_model import Code2VecModel
 from tests.helpers import build_tiny_dataset

@@ -2,7 +2,6 @@
 -> loss decreases & F1 beats naive; checkpoint -> resume continuity;
 release + predict round-trip."""
 
-import numpy as np
 import pytest
 
 from code2vec_tpu.config import Config

@@ -1,7 +1,6 @@
 """Pallas fused attention-pool vs the XLA reference implementation
 (interpret mode on the CPU test platform)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -7,7 +7,7 @@ import numpy as np
 import optax
 import pytest
 
-from code2vec_tpu.models.encoder import ModelDims, encode, full_logits, \
+from code2vec_tpu.models.encoder import ModelDims, full_logits, \
     init_params
 from code2vec_tpu.parallel.mesh import make_mesh
 from code2vec_tpu.parallel.sharding import (param_pspecs, shard_batch,

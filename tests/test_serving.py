@@ -1,0 +1,56 @@
+"""Interactive predict REPL (SURVEY.md §4.4): scripted session over a
+real Java file through the native extractor — prints top-k predictions
+and attention-ranked contexts, exits on 'q'."""
+
+import os
+
+import pytest
+
+from code2vec_tpu.models.jax_model import Code2VecModel
+from code2vec_tpu.serving.interactive_predict import InteractivePredictor
+from tests.helpers import build_tiny_dataset
+from tests.test_model import tiny_config
+
+BIN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "code2vec_tpu", "extractor", "build",
+    "c2v_extract")
+
+JAVA_SRC = """class Demo {
+  int count;
+  int getCount(int base) {
+    int result = base + count;
+    if (result > base) { result -= 1; }
+    return result;
+  }
+}
+"""
+
+
+@pytest.mark.skipif(not (os.path.exists(BIN)
+                         or os.path.exists(BIN.replace(
+                             "c2v_extract", "libc2v.so"))),
+                    reason="native extractor not built")
+def test_repl_scripted_session(tmp_path, monkeypatch, capsys):
+    ds_dir = tmp_path / "ds"
+    ds_dir.mkdir()
+    prefix = build_tiny_dataset(str(ds_dir), n_train=128,
+                                n_val=16, n_test=16, max_contexts=16)
+    cfg = tiny_config(prefix, NUM_TRAIN_EPOCHS=2)
+    model = Code2VecModel(cfg)
+    model.train()
+
+    input_file = str(tmp_path / "Input.java")
+    with open(input_file, "w") as f:
+        f.write(JAVA_SRC)
+
+    answers = iter(["", "q"])  # one prediction round, then exit
+    monkeypatch.setattr("builtins.input", lambda: next(answers))
+    InteractivePredictor(cfg, model).predict(input_file=input_file)
+
+    out = capsys.readouterr().out
+    assert "Serving." in out
+    assert "Original name:" in out
+    assert "predicted:" in out
+    assert "Attention:" in out
+    assert "context:" in out
+    assert "Exiting..." in out

@@ -3,7 +3,6 @@
 incl. padding/mask edge cases: 0 contexts, >max contexts, OOV")."""
 
 import numpy as np
-import pytest
 
 from code2vec_tpu.data.reader import (BinaryShardReader, C2VTextReader,
                                       parse_c2v_rows)

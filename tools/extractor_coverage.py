@@ -33,7 +33,10 @@ def measure(java_dir: str, expected: int, num_threads: int = 4) -> dict:
     out = subprocess.run(
         [EXTRACTOR, "--dir", java_dir, "--max_path_length", "8",
          "--max_path_width", "2", "--num_threads", str(num_threads)],
-        capture_output=True, text=True, check=True)
+        capture_output=True, text=True)
+    if out.returncode != 0:
+        sys.exit(f"extractor failed (rc={out.returncode}):\n"
+                 f"{out.stderr}")
     lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
     ctx_counts = [len(ln.split(" ")) - 1 for ln in lines]
     ctx_counts.sort()
@@ -65,6 +68,9 @@ def main() -> None:
                  "./build_extractor.sh")
 
     if args.dir:
+        if args.expected <= 0:
+            sys.exit("--dir requires --expected N (the known method "
+                     "count) — coverage is the whole point of the tool")
         stats = measure(args.dir, args.expected, args.num_threads)
     else:
         with tempfile.TemporaryDirectory() as tmp:

@@ -74,7 +74,6 @@ def main() -> None:
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    import jax
     import jax.numpy as jnp
 
     from code2vec_tpu.config import Config
