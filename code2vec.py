@@ -27,6 +27,25 @@ def main() -> int:
     # first backend touch; single-host runs detect nothing and continue.
     maybe_initialize(config.DIST_COORDINATOR, config.DIST_NUM_PROCESSES,
                      config.DIST_PROCESS_ID, log=config.log)
+    # Preemption recovery: with --auto_resume, an existing checkpoint in
+    # --save turns this run into a resume of itself — the SAME command
+    # line continues after a pod restart instead of training from
+    # scratch. This takes precedence over --load (a fine-tune's base
+    # checkpoint): after a preemption the run's OWN progress in --save
+    # is the thing to restore; --load applies only on the first run.
+    if config.AUTO_RESUME and config.is_saving and config.is_training:
+        from code2vec_tpu.training.checkpoint import latest_step
+        step = latest_step(config.save_path)
+        if step is not None:
+            if config.is_loading and config.load_path != config.save_path:
+                config.log(
+                    f"--auto_resume: --save has checkpoint step {step}; "
+                    f"resuming from it INSTEAD of --load "
+                    f"{config.load_path}")
+            else:
+                config.log(f"--auto_resume: found checkpoint step "
+                           f"{step} in {config.save_path}; resuming")
+            config.load_path = config.save_path
     # A checkpoint knows which head trained it; adopt (or cross-check)
     # the manifest so `--load <vm_ckpt>` works without re-passing --head.
     if config.is_loading:

@@ -128,6 +128,10 @@ class Config:
     load_path: Optional[str] = None         # --load <ckpt>
     is_predict: bool = False                # --predict
     release: bool = False                   # --release
+    # --auto_resume: if --save already contains a checkpoint, load it
+    # and continue training (preemption-friendly pod runs: the same
+    # command line resumes after a restart instead of starting over).
+    AUTO_RESUME: bool = False
     export_code_vectors: bool = False       # --export_code_vectors
     save_w2v: Optional[str] = None          # --save_w2v <path>
     save_t2v: Optional[str] = None          # --save_t2v <path>
@@ -220,6 +224,9 @@ class Config:
         p.add_argument("--load", dest="load_path", default=None)
         p.add_argument("--predict", action="store_true")
         p.add_argument("--release", action="store_true")
+        p.add_argument("--auto_resume", action="store_true",
+                       help="resume from --save's latest checkpoint "
+                            "when one exists (preemption recovery)")
         p.add_argument("--export_code_vectors", action="store_true")
         p.add_argument("--save_w2v", dest="save_w2v", default=None)
         p.add_argument("--save_t2v", dest="save_t2v", default=None)
@@ -294,6 +301,7 @@ class Config:
         cfg.load_path = ns.load_path
         cfg.is_predict = ns.predict
         cfg.release = ns.release
+        cfg.AUTO_RESUME = ns.auto_resume
         cfg.export_code_vectors = ns.export_code_vectors
         cfg.save_w2v = ns.save_w2v
         cfg.save_t2v = ns.save_t2v

@@ -33,11 +33,16 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 def _step_dirs(ckpt_dir: str):
+    """COMMITTED step dirs only: a preemption mid-save leaves a torn
+    step_N/ holding an orbax temp dir but no renamed `state` — counting
+    it would turn auto-resume (and --load latest) into a crash loop on
+    exactly the interruption it exists to survive."""
     out = []
     if os.path.isdir(ckpt_dir):
         for name in os.listdir(ckpt_dir):
             m = _STEP_RE.match(name)
-            if m:
+            if m and os.path.exists(os.path.join(ckpt_dir, name,
+                                                 "state")):
                 out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
     return sorted(out)
 

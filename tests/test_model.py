@@ -198,3 +198,45 @@ def test_tensorboard_scalars_written(dataset, tmp_path):
     for root, _d, files in os.walk(tb):
         events.extend(f for f in files if "tfevents" in f)
     assert events, f"no event files under {tb}"
+
+
+def test_auto_resume_via_cli_dispatch(dataset, tmp_path, monkeypatch):
+    """--auto_resume turns a rerun of the same command into a resume:
+    second invocation loads the checkpoint the first one saved."""
+    import sys
+
+    import code2vec as cli
+    ckpt = str(tmp_path / "ckpt")
+    argv = ["code2vec.py", "--data", dataset, "--save", ckpt,
+            "--epochs", "1", "--batch_size", "32", "--max_contexts",
+            "16", "--auto_resume"]
+    monkeypatch.setattr(sys, "argv", argv)
+    assert cli.main() == 0
+    from code2vec_tpu.training.checkpoint import latest_step
+    step1 = latest_step(ckpt)
+    assert step1 and step1 > 0
+
+    # same command line again: must RESUME (step count advances from
+    # the restored step, not from zero)
+    monkeypatch.setattr(sys, "argv", argv)
+    assert cli.main() == 0
+    step2 = latest_step(ckpt)
+    assert step2 == 2 * step1
+
+
+def test_auto_resume_ignores_torn_checkpoint_dir(dataset, tmp_path,
+                                                 monkeypatch):
+    """A step dir without a committed `state` (preemption mid-save) must
+    be invisible to latest_step, so auto-resume restarts cleanly."""
+    import os
+
+    from code2vec_tpu.training.checkpoint import latest_step
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(os.path.join(ckpt, "step_7"))  # torn: no state/ inside
+    assert latest_step(ckpt) is None
+
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=1, SAVE_EVERY_EPOCHS=1,
+                      save_path=ckpt)
+    model = Code2VecModel(cfg)
+    model.train()
+    assert latest_step(ckpt) == model.step_num  # real save is visible
