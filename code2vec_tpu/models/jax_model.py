@@ -25,7 +25,7 @@ from code2vec_tpu.data.reader import (BatchTensors, _pad_batch, open_reader,
 from code2vec_tpu.models.encoder import ModelDims, init_params
 from code2vec_tpu.models.model_base import Code2VecModelBase, MetricAccumulator
 from code2vec_tpu.parallel.distributed import fetch_global
-from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, make_mesh
+from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
@@ -48,15 +48,10 @@ class Code2VecModel(Code2VecModelBase):
 
         # ---- mesh (SURVEY.md §3.3): data axis for DP, model axis for
         # sharded vocab tables; single-device runs use no mesh. ----
-        n_dev = len(jax.devices())
-        self.mesh = None
+        from code2vec_tpu.models.setup import build_mesh, build_optimizer
+        self.mesh = build_mesh(cfg)
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
-        ctx_axis = max(1, cfg.MESH_CONTEXT_AXIS)
-        dcn_axis = max(1, cfg.MESH_DCN_AXIS)
-        if n_dev > 1 or model_axis > 1 or ctx_axis > 1 or dcn_axis > 1:
-            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis,
-                                  ctx_axis, dcn=dcn_axis)
-        self.shard_contexts = ctx_axis > 1
+        self.shard_contexts = max(1, cfg.MESH_CONTEXT_AXIS) > 1
 
         if cfg.is_loading:
             # Dims come from the checkpoint manifest, not the CLI: a model
@@ -97,34 +92,18 @@ class Code2VecModel(Code2VecModelBase):
                 xf_remat=cfg.XF_REMAT,
                 ring_attention=cfg.RING_ATTENTION,
             )
-        from code2vec_tpu.training.optimizers import make_lr, make_optimizer
-        # The schedule must match what the checkpoint's opt_state was
-        # built with (a schedule adds a count leaf to the LR transform),
-        # including eval/predict-only loads — cfg.LR_SCHEDULE already
-        # carries the manifest value when loading.
-        from code2vec_tpu.training.optimizers import schedule_total_steps
-        schedule = cfg.LR_SCHEDULE
-        total_steps = 0
-        if schedule != "constant":
-            if cfg.is_training:
-                # dict pickle already carries the count; rescan the file
-                # only for foreign datasets missing it
-                n = self.vocabs.num_training_examples
-                if not n:
-                    from code2vec_tpu.data.reader import count_examples
-                    n = count_examples(cfg.data_path("train"))
-                total_steps = schedule_total_steps(
-                    n, cfg.TRAIN_BATCH_SIZE, cfg.NUM_TRAIN_EPOCHS,
-                    num_hosts=jax.process_count(),
-                    restored_step=(int(manifest.get("step", 0))
-                                   if cfg.is_loading else 0))
-            else:
-                # eval/predict take no optimizer steps; any positive
-                # horizon yields the right opt_state STRUCTURE
-                total_steps = 1
-        self.optimizer = make_optimizer(
-            make_lr(cfg.LEARNING_RATE, schedule, total_steps),
-            cfg.EMBEDDING_OPTIMIZER)
+        def n_train_examples() -> int:
+            # dict pickle already carries the count; rescan the file
+            # only for foreign datasets missing it
+            n = self.vocabs.num_training_examples
+            if not n:
+                from code2vec_tpu.data.reader import count_examples
+                n = count_examples(cfg.data_path("train"))
+            return n
+
+        self.optimizer = build_optimizer(
+            cfg, n_train_examples,
+            manifest if cfg.is_loading else None)
         self.rng = jax.random.PRNGKey(cfg.SEED)
 
         # ---- params: load (--load) or init ----

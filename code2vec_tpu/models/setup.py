@@ -1,0 +1,61 @@
+"""Shared model-construction helpers for the two heads.
+
+jax_model.Code2VecModel and vm_model.VarMisuseModel mirror each other's
+lifecycle; the mesh construction and the LR-schedule/optimizer
+resolution (manifest-aware, resume-horizon-extending) live here once so
+the heads cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.parallel.mesh import make_mesh
+
+
+def build_mesh(cfg: Config, *, with_context_axis: bool = True):
+    """The model's mesh (or None for a plain single-device run): all
+    axes from config, sized 1 when unused."""
+    n_dev = len(jax.devices())
+    model_axis = max(1, cfg.MESH_MODEL_AXIS)
+    ctx_axis = max(1, cfg.MESH_CONTEXT_AXIS) if with_context_axis else 1
+    dcn_axis = max(1, cfg.MESH_DCN_AXIS)
+    if n_dev > 1 or model_axis > 1 or ctx_axis > 1 or dcn_axis > 1:
+        return make_mesh(cfg.MESH_DATA_AXIS, model_axis, ctx_axis,
+                         dcn=dcn_axis)
+    return None
+
+
+def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
+                    manifest: Optional[dict]):
+    """The optimizer with the LR schedule resolved exactly as the
+    checkpoint (if any) demands:
+
+    - schedule comes from cfg (already manifest-overridden when
+      loading — the opt_state structure is fixed at first training);
+    - a non-constant schedule needs a decay horizon: this run's step
+      count (from `count_examples_fn`, only called when training)
+      extended past the restored step on resume;
+    - eval/predict-only runs take no optimizer steps, so horizon 1
+      yields the right opt_state STRUCTURE.
+    """
+    from code2vec_tpu.training.optimizers import (make_lr, make_optimizer,
+                                                  schedule_total_steps)
+    schedule = cfg.LR_SCHEDULE
+    total_steps = 0
+    if schedule != "constant":
+        if cfg.is_training:
+            total_steps = schedule_total_steps(
+                count_examples_fn(), cfg.TRAIN_BATCH_SIZE,
+                cfg.NUM_TRAIN_EPOCHS,
+                num_hosts=jax.process_count(),
+                restored_step=(int(manifest.get("step", 0))
+                               if cfg.is_loading and manifest else 0))
+        else:
+            total_steps = 1
+    return make_optimizer(
+        make_lr(cfg.LEARNING_RATE, schedule, total_steps),
+        cfg.EMBEDDING_OPTIMIZER)

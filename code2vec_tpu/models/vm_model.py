@@ -19,11 +19,10 @@ from code2vec_tpu.data.vm_reader import (VMTextReader, build_vm_vocabs)
 from code2vec_tpu.models.encoder import ModelDims
 from code2vec_tpu.models.varmisuse import init_vm_params
 from code2vec_tpu.parallel.distributed import fetch_global
-from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, make_mesh
+from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
-from code2vec_tpu.training.optimizers import make_optimizer
 from code2vec_tpu.training.profiler import StepProfiler
 from code2vec_tpu.training.vm_steps import (make_vm_eval_step,
                                             make_vm_train_step)
@@ -49,13 +48,10 @@ class VarMisuseModel:
         self.use_pallas = (cfg.USE_PALLAS
                            and jax.default_backend() == "tpu")
 
-        n_dev = len(jax.devices())
-        self.mesh = None
+        from code2vec_tpu.models.setup import build_mesh, build_optimizer
+        # no context axis: the vm head is bag-encoder-only (Config.verify)
+        self.mesh = build_mesh(cfg, with_context_axis=False)
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
-        dcn_axis = max(1, cfg.MESH_DCN_AXIS)
-        if n_dev > 1 or model_axis > 1 or dcn_axis > 1:
-            self.mesh = make_mesh(cfg.MESH_DATA_AXIS, model_axis,
-                                  dcn=dcn_axis)
 
         if cfg.is_loading:
             self.dims = ckpt.load_dims(cfg.load_path)
@@ -88,26 +84,13 @@ class VarMisuseModel:
                 vocab_pad_multiple=model_axis,
                 tables_dtype=cfg.TABLES_DTYPE,
             )
-        # schedule handling mirrors jax_model.py: structure must match
-        # the checkpoint's; eval-only loads need only the structure
-        from code2vec_tpu.training.optimizers import (make_lr,
-                                                      schedule_total_steps)
-        schedule = cfg.LR_SCHEDULE
-        total_steps = 0
-        if schedule != "constant":
-            if cfg.is_training:
-                from code2vec_tpu.data.reader import count_examples
-                total_steps = schedule_total_steps(
-                    count_examples(self._vm_path("train")),
-                    cfg.TRAIN_BATCH_SIZE, cfg.NUM_TRAIN_EPOCHS,
-                    num_hosts=jax.process_count(),
-                    restored_step=(int(manifest.get("step", 0))
-                                   if cfg.is_loading else 0))
-            else:
-                total_steps = 1
-        self.optimizer = make_optimizer(
-            make_lr(cfg.LEARNING_RATE, schedule, total_steps),
-            cfg.EMBEDDING_OPTIMIZER)
+        def n_train_examples() -> int:
+            from code2vec_tpu.data.reader import count_examples
+            return count_examples(self._vm_path("train"))
+
+        self.optimizer = build_optimizer(
+            cfg, n_train_examples,
+            manifest if cfg.is_loading else None)
         self.rng = jax.random.PRNGKey(cfg.SEED)
         self.rng, init_rng = jax.random.split(self.rng)
         params = init_vm_params(init_rng, self.dims)
