@@ -78,3 +78,19 @@ def test_ring_on_combined_dcn_ctx_mesh():
     out_ring = ring_attention(q, k, v, mask, mesh)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
                                atol=1e-5)
+
+
+def test_ring_matches_dense_bf16_compute():
+    """bf16 q/k/v (the TPU compute dtype): the f32 running-softmax
+    accumulators must keep ring ~ dense within bf16 tolerance."""
+    q, k, v, mask = _inputs()
+    q = q.astype(jnp.bfloat16)
+    k = k.astype(jnp.bfloat16)
+    v = v.astype(jnp.bfloat16)
+    mesh = make_mesh(4, 1, 2)
+    out_ref = dense_oracle(q, k, v, mask)
+    out_ring = ring_attention(q, k, v, mask, mesh)
+    assert out_ring.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out_ring, np.float32), np.asarray(out_ref, np.float32),
+        atol=2e-2)
