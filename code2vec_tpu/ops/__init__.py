@@ -1,3 +1,4 @@
 from code2vec_tpu.ops.attention import attention_pool  # noqa: F401
+from code2vec_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from code2vec_tpu.ops.sampled_softmax import (  # noqa: F401
     sampled_softmax_loss, log_uniform_sample)

@@ -1,2 +1,7 @@
 from code2vec_tpu.training.steps import (  # noqa: F401
-    make_train_step, make_eval_step, make_predict_step)
+    make_train_step, make_train_loss_fn, make_eval_step,
+    make_predict_step)
+from code2vec_tpu.training.optimizers import (  # noqa: F401
+    make_optimizer, make_lr)
+from code2vec_tpu.training.profiler import StepProfiler  # noqa: F401
+from code2vec_tpu.training.scalars import ScalarWriter  # noqa: F401
