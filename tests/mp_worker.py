@@ -93,6 +93,22 @@ def main() -> None:
     checksum = float(sum(jnp.sum(fetch_global(v).astype(np.float64))
                          for v in params.values()))
 
+    # --- ring attention across the REAL process boundary. Mesh layout
+    # matters: jax.devices() reshapes to (dcn, data, ctx, model), and
+    # process 0 owns devices 0-3 — with data>1 the ctx pairs would stay
+    # intra-process. data=1, ctx=2, model=4 puts ctx shard 0 on process
+    # 0's devices and shard 1 on process 1's, so every ppermute K/V hop
+    # crosses the Gloo boundary; result must equal the dense oracle.
+    from code2vec_tpu.ops.ring_attention import ring_attention
+    from test_ring_attention import _inputs, dense_oracle
+    q, kk, vv, rmask = _inputs(seed=5)
+    ring_mesh = make_mesh(1, 4, 2)
+    assert dict(ring_mesh.shape) == {"dcn": 1, "data": 1, "ctx": 2,
+                                     "model": 4}
+    ring_out = fetch_global(ring_attention(q, kk, vv, rmask, ring_mesh))
+    ring_max_err = float(jnp.max(jnp.abs(
+        ring_out - dense_oracle(q, kk, vv, rmask))))
+
     # --- model-level SHARDED evaluate: each host parses a disjoint shard
     # of the eval file; metric partials allreduce at the end
     # (jax_model.evaluate multi-host path) ---
@@ -112,7 +128,8 @@ def main() -> None:
              eval_loss=float(loss_sum), topk=np.asarray(topk_host),
              m_eval_loss=eval_res.loss,
              m_eval_top1=eval_res.topk_acc[0],
-             m_eval_f1=eval_res.subtoken_f1)
+             m_eval_f1=eval_res.subtoken_f1,
+             ring_max_err=ring_max_err)
 
 
 if __name__ == "__main__":

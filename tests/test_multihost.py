@@ -223,6 +223,10 @@ def test_sharded_eval_matches_single_process(two_process_results,
     # both hosts report the identical merged metrics
     for k in ("m_eval_loss", "m_eval_top1", "m_eval_f1"):
         np.testing.assert_allclose(r0[k], r1[k], rtol=1e-6, err_msg=k)
+    # ring attention with the ctx ring spanning the process boundary
+    # (K/V ppermute over Gloo) matched the dense oracle on both hosts
+    assert float(r0["ring_max_err"]) < 1e-5
+    assert float(r1["ring_max_err"]) < 1e-5
     np.testing.assert_allclose(r0["m_eval_loss"], oracle.loss, rtol=1e-4)
     np.testing.assert_allclose(r0["m_eval_top1"], oracle.topk_acc[0],
                                atol=1e-6)
