@@ -41,13 +41,14 @@ VARIANTS = {
 def run_variant(name: str, data: str, epochs: int, batch: int,
                 num_sampled: int, seed: int, lr: float = 1e-3,
                 lr_schedule: str = "constant",
+                max_contexts: int = 200,
                 save_path: str = None) -> dict:
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
 
     use_sampled, tdtype, eopt, encoder = VARIANTS[name]
     cfg = Config(
-        MAX_CONTEXTS=200,
+        MAX_CONTEXTS=max_contexts,
         MAX_TOKEN_VOCAB_SIZE=150_000,
         MAX_PATH_VOCAB_SIZE=150_000,
         MAX_TARGET_VOCAB_SIZE=60_000,
@@ -87,6 +88,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         "batch": batch,
         "lr": lr,
         "lr_schedule": lr_schedule,
+        "max_contexts": max_contexts,
         "steps": model.step_num,
         "train_seconds": round(train_s, 1),
         "val_loss": round(float(res.loss), 4),
@@ -114,6 +116,9 @@ def main() -> None:
     ap.add_argument("--lr_schedule", default="constant",
                     choices=["constant", "cosine", "linear"])
     ap.add_argument("--num_sampled", type=int, default=1024)
+    ap.add_argument("--max_contexts", type=int, default=200,
+                    help="match the dataset's binarized width (200 for "
+                         "the production corpus; smaller for smokes)")
     ap.add_argument("--seed", type=int, default=239)
     ap.add_argument("--variants", default=",".join(VARIANTS))
     ap.add_argument("--save", default=None,
@@ -128,6 +133,7 @@ def main() -> None:
         r = run_variant(name.strip(), args.data, args.epochs, args.batch,
                         args.num_sampled, args.seed, lr=args.lr,
                         lr_schedule=args.lr_schedule,
+                        max_contexts=args.max_contexts,
                         save_path=(args.save + "." + name.strip()
                                    if args.save else None))
         results.append(r)
