@@ -61,6 +61,13 @@ def main() -> int:
                       file=sys.stderr)
                 return 2
             config.HEAD = ckpt_head
+    # Config.verify() ran before the manifest could set HEAD; re-check
+    # the head-dependent guard now that the effective head is known.
+    if config.ATTACK and config.HEAD == "varmisuse":
+        print("error: --attack applies to the code2vec head only "
+              "(checkpoint was trained with --head varmisuse)",
+              file=sys.stderr)
+        return 2
 
     from code2vec_tpu.serving.interactive_predict import InteractivePredictor
     if config.HEAD == "varmisuse":
@@ -73,6 +80,33 @@ def main() -> int:
 
     if config.release:
         model.release()
+        return 0
+
+    if config.ATTACK:
+        # Adversarial attack on --attack_input's source (the noamyft
+        # fork delta; attacks/source_attack.py). The printed outcome is
+        # the model's prediction on the REWRITTEN source, re-extracted.
+        from code2vec_tpu.attacks.source_attack import SourceAttack
+        from code2vec_tpu.common import split_to_subtokens
+        target = config.ATTACK_TARGET
+        if target and "|" not in target:
+            target = "|".join(split_to_subtokens(target))
+        attack = SourceAttack(config, model,
+                              top_k_candidates=config.ATTACK_TOPK,
+                              max_iters=config.ATTACK_ITERS)
+        result = attack.attack_file(
+            config.ATTACK_INPUT,
+            method_index=config.ATTACK_METHOD_INDEX,
+            targeted=config.ATTACK == "targeted",
+            target_name=target,
+            max_renames=config.ATTACK_MAX_RENAMES,
+            deadcode=config.ATTACK_DEADCODE)
+        print(str(result))
+        if result.adversarial_source is not None:
+            dest = config.ATTACK_INPUT + ".adversarial"
+            with open(dest, "w", encoding="utf-8") as f:
+                f.write(result.adversarial_source)
+            config.log(f"adversarial source -> {dest}")
         return 0
 
     if config.is_training:

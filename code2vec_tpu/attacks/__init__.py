@@ -1,0 +1,24 @@
+"""Adversarial attacks on path-context models (the `noamyft/code2vec`
+fork delta — SURVEY.md §0 item 2; "Adversarial Examples for Models of
+Code", Yefet, Alon & Yahav 2020).
+
+- gradient_attack: tensor-space gradient-guided variable renaming
+  (targeted + untargeted), HotFlip-style vocab-wide candidate scoring
+  on the MXU with exact batched re-scoring.
+- source_attack: source-level driver (rename / dead-code insertion in
+  real Java or Python source) verified end-to-end via re-extraction.
+- robustness: untargeted attack sweep over a test split -> robustness
+  metrics (module CLI).
+"""
+
+from code2vec_tpu.attacks.gradient_attack import (AttackResult,
+                                                  GradientRenameAttack,
+                                                  candidate_mask,
+                                                  render_identifier)
+from code2vec_tpu.attacks.robustness import evaluate_robustness
+from code2vec_tpu.attacks.source_attack import (SourceAttack,
+                                                SourceAttackResult)
+
+__all__ = ["AttackResult", "GradientRenameAttack", "candidate_mask",
+           "render_identifier", "SourceAttack", "SourceAttackResult",
+           "evaluate_robustness"]

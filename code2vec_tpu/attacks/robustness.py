@@ -1,0 +1,126 @@
+"""Adversarial-robustness evaluation: untargeted rename attacks over a
+test split, reported as model robustness metrics.
+
+Reference parity target: the evaluation protocol of "Adversarial
+Examples for Models of Code" (Yefet, Alon & Yahav 2020 — the
+`noamyft/code2vec` fork delta, SURVEY.md §0 item 2): attack every method
+in a held-out set with the untargeted one-variable rename attack and
+report the attack success rate (= 1 - model robustness). Runs against
+any checkpoint of this framework.
+
+CLI (module-style, like data/preprocess and data/binarize):
+
+  python -m code2vec_tpu.attacks.robustness \
+      --load <ckpt> --test <file.c2v> [--n 200] [--max_renames 1] \
+      [--iters 4] [--topk 32] [--out robustness.json]
+
+Prints one JSON line: attack success rate, mean iterations/renames on
+successes, and the clean-vs-attacked top-1-vs-ground-truth breakdown.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from code2vec_tpu.attacks.gradient_attack import GradientRenameAttack
+from code2vec_tpu.data.reader import parse_c2v_rows
+
+
+def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
+                        max_renames: int = 1, max_iters: int = 4,
+                        top_k_candidates: int = 32,
+                        log=print) -> dict:
+    """Attacks up to `n_methods` methods of `test_path` (untargeted,
+    greedy rename of up to `max_renames` variables) and aggregates."""
+    attack = GradientRenameAttack(
+        model.dims, model.vocabs.token_vocab, model.vocabs.target_vocab,
+        top_k_candidates=top_k_candidates, max_iters=max_iters,
+        compute_dtype=model.compute_dtype)
+    tv = model.vocabs.target_vocab
+
+    with open(test_path, encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()][:n_methods]
+    labels, src, pth, dst, mask, tstr, _ = parse_c2v_rows(
+        lines, model.vocabs, model.dims.max_contexts, keep_strings=True)
+
+    n = flipped = clean_correct = attacked_correct = 0
+    iters_on_success, renames_on_success = [], []
+    t0 = time.time()
+    for i in range(len(lines)):
+        if mask[i].sum() == 0:
+            continue
+        method = (src[i], pth[i], dst[i], mask[i])
+        if not attack.attackable_tokens(src[i], dst[i], mask[i]):
+            continue
+        res = attack.attack_method(model.params, method,
+                                   targeted=False,
+                                   max_renames=max_renames)
+        n += 1
+        truth = tv.lookup_word(int(labels[i])) if not tstr else tstr[i]
+        clean_correct += res.original_prediction == truth
+        attacked_correct += res.final_prediction == truth
+        if res.success:
+            flipped += 1
+            iters_on_success.append(res.iterations)
+            renames_on_success.append(len(res.renames))
+        if n % 25 == 0:
+            log(f"robustness: {n} methods, "
+                f"{flipped / n:.3f} attack success rate so far")
+    dt = time.time() - t0
+    return {
+        "metric": "untargeted_rename_attack_success_rate",
+        "n_methods": n,
+        "attack_success_rate": round(flipped / max(n, 1), 4),
+        "robustness": round(1.0 - flipped / max(n, 1), 4),
+        "clean_top1_acc": round(clean_correct / max(n, 1), 4),
+        "attacked_top1_acc": round(attacked_correct / max(n, 1), 4),
+        "mean_iterations_on_success": round(
+            float(np.mean(iters_on_success)), 2) if iters_on_success
+        else None,
+        "mean_renames_on_success": round(
+            float(np.mean(renames_on_success)), 2) if renames_on_success
+        else None,
+        "max_renames": max_renames,
+        "max_iters": max_iters,
+        "top_k_candidates": top_k_candidates,
+        "seconds": round(dt, 1),
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.models.jax_model import Code2VecModel
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--load", required=True, help="checkpoint directory")
+    p.add_argument("--test", required=True, help=".c2v file to attack")
+    p.add_argument("--n", type=int, default=200)
+    p.add_argument("--max_renames", type=int, default=1)
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--topk", type=int, default=32)
+    p.add_argument("--out", default=None, help="also write JSON here")
+    a = p.parse_args(argv)
+
+    cfg = Config()
+    cfg.load_path = a.load
+    model = Code2VecModel(cfg)
+    report = evaluate_robustness(
+        model, a.test, n_methods=a.n, max_renames=a.max_renames,
+        max_iters=a.iters, top_k_candidates=a.topk, log=cfg.log)
+    line = json.dumps(report)
+    print(line)
+    if a.out:
+        with open(a.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""Source-level adversarial attack driver: rename variables / insert
+dead code in real Java source, verified end-to-end through the extractor.
+
+Reference parity target: the `noamyft/code2vec` fork delta (SURVEY.md §0
+item 2; "Adversarial Examples for Models of Code", Yefet, Alon & Yahav
+2020). The tensor-space search lives in attacks/gradient_attack.py; this
+module closes the loop to actual source code:
+
+  extract -> tensorize -> gradient attack -> rewrite the source ->
+  RE-extract -> RE-predict  (the reported outcome is always the model's
+  output on the rewritten source, never the tensor-space estimate).
+
+Two manipulations, per the paper:
+- **variable rename**: replace every occurrence of one declared
+  variable (local/param/field, found by a declaration heuristic) with
+  the adversarially-chosen name — semantics-preserving.
+- **dead-code insertion** (`--attack_deadcode`): insert an unused local
+  declaration `int <advName>;` at the top of the method body and let the
+  gradient attack choose `<advName>` — the program's behavior is
+  untouched, only the name of a dead variable changes the prediction.
+
+Validity guards: candidate new names exclude every identifier already
+present in the source (no shadowing/duplicate-declaration collisions),
+and the rename targets are restricted to identifiers that appear in a
+declaration position (`Type name`), so called methods and type names are
+not rewritten. The identifier mapping is still heuristic — the extractor
+normalizes leaf tokens (`common.split_to_subtokens`), so distinct
+identifiers can collapse to one vocab token, and the word-boundary
+rewrite does not parse string literals/comments. Acceptable for the
+attack setting: the rewritten file is re-extracted, so the reported
+prediction is always truthful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu.attacks.gradient_attack import (AttackResult,
+                                                  GradientRenameAttack,
+                                                  render_identifier)
+from code2vec_tpu.common import split_to_subtokens
+from code2vec_tpu.data.reader import parse_c2v_rows
+from code2vec_tpu.serving.extractor import Extractor
+
+_IDENT_RE = re.compile(r"\b[A-Za-z_][A-Za-z0-9_]*\b")
+_JAVA_KEYWORDS = frozenset(
+    "abstract assert boolean break byte case catch char class const "
+    "continue default do double else enum extends final finally float "
+    "for goto if implements import instanceof int interface long native "
+    "new package private protected public return short static strictfp "
+    "super switch synchronized this throw throws transient try void "
+    "volatile while true false null var String".split())
+# keywords that may legally precede an identifier but are NOT types —
+# `return index;` must not read as a declaration of `index`
+_NOT_A_TYPE = frozenset(
+    "return new case throw else do instanceof class interface enum "
+    "extends implements throws package import goto break continue "
+    "assert".split())
+_DECL_RE = re.compile(
+    r"\b([A-Za-z_][A-Za-z0-9_]*)"          # base type identifier
+    r"(?:\s*<[^<>;(){}]*>)?(?:\s*\[\s*\])*"  # generics / array suffix
+    r"\s+([a-z_][A-Za-z0-9_]*)\s*(?=[=;,):])")  # variable name
+
+
+def normalize_identifier(ident: str) -> str:
+    return "|".join(split_to_subtokens(ident))
+
+
+def declared_variables(source: str) -> List[str]:
+    """Identifiers in declaration position (`Type name` followed by
+    `= ; , ) :`): params, locals, fields. Heuristic — a regex, not a
+    parser — but it excludes called methods and type names, which is
+    what keeps the rewrite semantics-preserving."""
+    out, seen = [], set()
+    for m in _DECL_RE.finditer(source):
+        type_word, name = m.group(1), m.group(2)
+        if type_word in _NOT_A_TYPE or name in _JAVA_KEYWORDS:
+            continue
+        if name not in seen:
+            seen.add(name)
+            out.append(name)
+    return out
+
+
+def identifiers_for_token(source: str, token_word: str,
+                          declared_only: bool = True) -> List[str]:
+    """Source identifiers that normalize to the stored vocab token."""
+    pool = (declared_variables(source) if declared_only else
+            [m.group(0) for m in _IDENT_RE.finditer(source)
+             if m.group(0) not in _JAVA_KEYWORDS])
+    found, seen = [], set()
+    for ident in pool:
+        if ident not in seen and normalize_identifier(ident) == token_word:
+            seen.add(ident)
+            found.append(ident)
+    return found
+
+
+def rename_in_source(source: str, old_ident: str, new_ident: str) -> str:
+    return re.sub(rf"\b{re.escape(old_ident)}\b", new_ident, source)
+
+
+def insert_dead_declaration(source: str, method_name_word: str,
+                            var_name: str, ordinal: int = 0
+                            ) -> Optional[str]:
+    """Insert `int <var_name>;` right after the opening brace of the
+    (ordinal-th) method whose extractor-normalized name is
+    `method_name_word`. Returns the modified source, or None if the
+    method isn't found."""
+    skip = ordinal
+    for m in _IDENT_RE.finditer(source):
+        if normalize_identifier(m.group(0)) != method_name_word:
+            continue
+        # require a parameter list then a brace: it's a method, not a
+        # use. The `[^{;)]*` between `)` and `{` rejects call sites in
+        # conditions — `if (check()) {` leaves a stray `)` after the
+        # matched parens that a declaration never has.
+        rest = source[m.end():]
+        sig = re.match(r"\s*\([^)]*\)[^{;)]*\{", rest, re.S)
+        if not sig:
+            continue
+        if skip > 0:
+            skip -= 1
+            continue
+        pos = m.end() + sig.end()
+        return source[:pos] + f" int {var_name}; " + source[pos:]
+    return None
+
+
+@dataclasses.dataclass
+class SourceAttackResult:
+    attack: AttackResult              # the tensor-space trajectory
+    renames: Dict[str, str]           # source-identifier rewrites applied
+    adversarial_source: Optional[str]
+    # predictions on the REWRITTEN source, re-extracted (ground truth):
+    verified_prediction: Optional[str]
+    verified_success: Optional[bool]
+
+    def __str__(self) -> str:
+        lines = [str(self.attack)]
+        if self.renames:
+            lines.append("source rewrites: " + ", ".join(
+                f"{a} -> {b}" for a, b in self.renames.items()))
+        if self.verified_prediction is not None:
+            lines.append(
+                f"re-extracted prediction: '{self.verified_prediction}' "
+                f"({'SUCCESS' if self.verified_success else 'failed'} "
+                f"end-to-end)")
+        return "\n".join(lines)
+
+
+class SourceAttack:
+    """Attacks one method of one source file against a loaded model."""
+
+    def __init__(self, config, model, *, top_k_candidates: int = 32,
+                 max_iters: int = 4):
+        self.config = config
+        self.model = model
+        self.extractor = Extractor(config)
+        self.attack = GradientRenameAttack(
+            model.dims, model.vocabs.token_vocab,
+            model.vocabs.target_vocab,
+            top_k_candidates=top_k_candidates, max_iters=max_iters,
+            compute_dtype=model.compute_dtype)
+
+    def _tensorize(self, line: str):
+        labels, src, pth, dst, mask, _, _ = parse_c2v_rows(
+            [line], self.model.vocabs, self.config.MAX_CONTEXTS,
+            keep_strings=True)
+        return int(labels[0]), (src[0], pth[0], dst[0], mask[0])
+
+    def _predict_word(self, method) -> str:
+        import jax.numpy as jnp
+        ids = tuple(jnp.asarray(a) for a in method)
+        top1, _ = self.attack.predict_fn(self.model.params, ids)
+        return self.model.vocabs.target_vocab.lookup_word(int(top1))
+
+    def _forbidden_ids(self, source: str) -> frozenset:
+        """Vocab ids of every identifier already in the source — never
+        valid as a NEW name (duplicate declarations / symbol capture)."""
+        tv = self.attack.token_vocab
+        ids = set()
+        for m in _IDENT_RE.finditer(source):
+            idx = tv.lookup_index(normalize_identifier(m.group(0)))
+            if idx != tv.oov_index:
+                ids.add(idx)
+        return frozenset(ids)
+
+    def attack_file(self, path: str, *, method_index: int = 0,
+                    targeted: bool = False,
+                    target_name: Optional[str] = None,
+                    max_renames: int = 1,
+                    deadcode: bool = False) -> SourceAttackResult:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        names, lines = self.extractor.extract_paths(path)
+        if method_index >= len(names):
+            raise ValueError(
+                f"file has {len(names)} methods, asked for "
+                f"#{method_index}")
+        method_name = names[method_index]
+        # overloads share a normalized name; track WHICH occurrence
+        ordinal = names[:method_index].count(method_name)
+
+        if deadcode:
+            var0 = self._fresh_variable_name(source)
+            mod = insert_dead_declaration(source, method_name, var0,
+                                          ordinal)
+            if mod is None:
+                raise ValueError(
+                    f"could not locate method '{method_name}' in {path} "
+                    f"to insert dead code")
+            return self._run(mod, method_name, ordinal, targeted,
+                             target_name, token_ids_from=var0,
+                             max_renames=1)
+        return self._run(source, method_name, ordinal, targeted,
+                         target_name, token_ids_from=None,
+                         max_renames=max_renames,
+                         extraction=(names, lines))
+
+    # ----------------------------------------------------------------
+    def _fresh_variable_name(self, source: str) -> str:
+        """An initial dead-variable name: in-vocab, identifier-renderable,
+        not already present in the source (so its occurrence slots are
+        exactly the inserted declaration's)."""
+        used = {normalize_identifier(m.group(0))
+                for m in _IDENT_RE.finditer(source)}
+        tv = self.attack.token_vocab
+        for idx in range(tv.size - 1, 1, -1):
+            word = tv.lookup_word(idx)
+            ident = render_identifier(word)
+            if ident and word not in used:
+                return ident
+        raise ValueError("no unused in-vocab identifier available")
+
+    def _extract_lines_of(self, source: str) -> Tuple[List[str],
+                                                      List[str]]:
+        suffix = ".py" if self.extractor.language == "python" else ".java"
+        fd, tmp = tempfile.mkstemp(suffix=suffix, prefix="c2v_attack_")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(source)
+            return self.extractor.extract_paths(tmp)
+        finally:
+            os.unlink(tmp)
+
+    @staticmethod
+    def _method_row(names: List[str], method_name: str,
+                    ordinal: int) -> int:
+        """Row of the (ordinal-th) method named `method_name`."""
+        matches = [i for i, n in enumerate(names) if n == method_name]
+        if not matches:
+            raise ValueError(f"method '{method_name}' not found after "
+                             f"re-extraction")
+        return matches[min(ordinal, len(matches) - 1)]
+
+    def _run(self, source: str, method_name: str, ordinal: int,
+             targeted: bool, target_name: Optional[str],
+             token_ids_from: Optional[str], max_renames: int,
+             extraction: Optional[Tuple[List[str], List[str]]] = None
+             ) -> SourceAttackResult:
+        names, lines = (extraction if extraction is not None
+                        else self._extract_lines_of(source))
+        idx = self._method_row(names, method_name, ordinal)
+        _, method = self._tensorize(lines[idx])
+        if token_ids_from is not None:
+            # dead-code mode: attack exactly the inserted variable
+            tid = self.attack.token_vocab.lookup_index(
+                normalize_identifier(token_ids_from))
+            token_ids = [tid]
+        else:
+            # rename mode: only tokens that map to a DECLARED variable
+            # in this source are legitimate rename targets
+            declared = {normalize_identifier(d)
+                        for d in declared_variables(source)}
+            token_ids = [t for t, _ in self.attack.attackable_tokens(
+                method[0], method[2], method[3])
+                if self.attack.token_vocab.lookup_word(t) in declared]
+        result = self.attack.attack_method(
+            self.model.params, method, targeted=targeted,
+            target_name=target_name, max_renames=max_renames,
+            token_ids=token_ids,
+            forbidden=self._forbidden_ids(source))
+
+        renames: Dict[str, str] = {}
+        adv_source = source
+        for orig_tok, final_tok in result.renames:
+            new_ident = render_identifier(final_tok)
+            if new_ident is None:
+                continue
+            if token_ids_from is not None and \
+                    normalize_identifier(token_ids_from) == orig_tok:
+                idents = [token_ids_from]
+            else:
+                idents = identifiers_for_token(source, orig_tok)
+            for ident in idents:
+                adv_source = rename_in_source(adv_source, ident,
+                                              new_ident)
+                renames[ident] = new_ident
+
+        verified_pred = verified_ok = None
+        if renames:
+            try:
+                v_names, v_lines = self._extract_lines_of(adv_source)
+                v_idx = self._method_row(v_names, method_name, ordinal)
+                _, v_method = self._tensorize(v_lines[v_idx])
+                verified_pred = self._predict_word(v_method)
+                if targeted:
+                    verified_ok = verified_pred == target_name
+                else:
+                    verified_ok = (verified_pred
+                                   != result.original_prediction)
+            except Exception as e:  # honest failure, not a crash
+                verified_pred = f"<re-extraction failed: {e}>"
+                verified_ok = False
+        return SourceAttackResult(
+            attack=result, renames=renames,
+            adversarial_source=adv_source if renames else None,
+            verified_prediction=verified_pred,
+            verified_success=verified_ok)

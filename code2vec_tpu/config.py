@@ -153,6 +153,19 @@ class Config:
     # as tf.summary scalars (host-side; TF is imported only when set).
     TENSORBOARD_DIR: Optional[str] = None
 
+    # ---- adversarial attacks (the noamyft fork delta, SURVEY.md §0
+    # item 2; attacks/): --attack {targeted,untargeted} runs the
+    # gradient-guided rename attack on --attack_input's source and
+    # reports the re-extracted, re-predicted outcome. ----
+    ATTACK: Optional[str] = None          # "targeted" | "untargeted"
+    ATTACK_TARGET: Optional[str] = None   # target method name (targeted)
+    ATTACK_INPUT: str = "Input.java"      # source file to attack
+    ATTACK_METHOD_INDEX: int = 0          # which method in the file
+    ATTACK_MAX_RENAMES: int = 1           # variables to rename (greedy)
+    ATTACK_DEADCODE: bool = False         # insert `int <adv>;` instead
+    ATTACK_TOPK: int = 32                 # exact-rescore shortlist size
+    ATTACK_ITERS: int = 4                 # rename iterations / variable
+
     def __post_init__(self) -> None:
         if self.TARGET_EMBEDDINGS_SIZE is None:
             self.TARGET_EMBEDDINGS_SIZE = self.code_vector_size
@@ -287,6 +300,30 @@ class Config:
                        default=None,
                        help="write loss/throughput/eval scalars as "
                             "TensorBoard summaries to this directory")
+        p.add_argument("--attack", dest="attack", default=None,
+                       choices=["targeted", "untargeted"],
+                       help="gradient-guided variable-rename attack on "
+                            "--attack_input (needs --load)")
+        p.add_argument("--attack_target", dest="attack_target",
+                       default=None,
+                       help="target method name for --attack targeted "
+                            "(camelCase or subtoken|form)")
+        p.add_argument("--attack_input", dest="attack_input",
+                       default=None, help="source file (default "
+                                          "Input.java)")
+        p.add_argument("--attack_method_index", dest="attack_method_index",
+                       type=int, default=None)
+        p.add_argument("--attack_max_renames", dest="attack_max_renames",
+                       type=int, default=None)
+        p.add_argument("--attack_deadcode", dest="attack_deadcode",
+                       action="store_true",
+                       help="insert a dead `int <adv>;` declaration and "
+                            "adversarially choose its name instead of "
+                            "renaming an existing variable")
+        p.add_argument("--attack_topk", dest="attack_topk", type=int,
+                       default=None)
+        p.add_argument("--attack_iters", dest="attack_iters", type=int,
+                       default=None)
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
 
@@ -362,6 +399,22 @@ class Config:
             cfg.PROFILE_STEPS = ns.profile_steps
         if ns.tensorboard_dir is not None:
             cfg.TENSORBOARD_DIR = ns.tensorboard_dir
+        if ns.attack is not None:
+            cfg.ATTACK = ns.attack
+        if ns.attack_target is not None:
+            cfg.ATTACK_TARGET = ns.attack_target
+        if ns.attack_input is not None:
+            cfg.ATTACK_INPUT = ns.attack_input
+        if ns.attack_method_index is not None:
+            cfg.ATTACK_METHOD_INDEX = ns.attack_method_index
+        if ns.attack_max_renames is not None:
+            cfg.ATTACK_MAX_RENAMES = ns.attack_max_renames
+        if ns.attack_deadcode:
+            cfg.ATTACK_DEADCODE = True
+        if ns.attack_topk is not None:
+            cfg.ATTACK_TOPK = ns.attack_topk
+        if ns.attack_iters is not None:
+            cfg.ATTACK_ITERS = ns.attack_iters
         if ns.verbose_mode is not None:
             cfg.VERBOSE_MODE = ns.verbose_mode
         cfg.verify()
@@ -407,6 +460,14 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES supports the bag encoder only "
                 "(sparse_steps.py trains no transformer params).")
+        if self.ATTACK and not self.is_loading:
+            raise ValueError("--attack requires --load.")
+        if self.ATTACK == "targeted" and not self.ATTACK_TARGET:
+            raise ValueError(
+                "--attack targeted requires --attack_target <name>.")
+        if self.ATTACK and self.HEAD == "varmisuse":
+            raise ValueError(
+                "--attack applies to the code2vec head only.")
         if self.HEAD == "varmisuse" and (self.ENCODER_TYPE != "bag"
                                          or self.MESH_CONTEXT_AXIS > 1):
             # vm_scores calls the bag encode() directly; accepting

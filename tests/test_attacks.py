@@ -1,0 +1,229 @@
+"""Adversarial-attack tests (the noamyft fork delta, SURVEY.md §0
+item 2): gradient-guided rename attacks against a small trained model —
+untargeted flip rate, targeted reachability, trajectory consistency,
+robustness sweep, and the source-level rename / dead-code drivers
+through the native extractor."""
+
+import os
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.attacks import (GradientRenameAttack, SourceAttack,
+                                  evaluate_robustness, render_identifier)
+from code2vec_tpu.attacks.source_attack import (identifiers_for_token,
+                                                insert_dead_declaration,
+                                                rename_in_source)
+from code2vec_tpu.data.reader import parse_c2v_rows
+from code2vec_tpu.models.jax_model import Code2VecModel
+from tests.helpers import build_tiny_dataset
+from tests.test_model import tiny_config
+
+EXTRACTOR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "code2vec_tpu", "extractor", "build",
+    "c2v_extract")
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    d = tmp_path_factory.mktemp("attack_data")
+    prefix = build_tiny_dataset(str(d), n_train=256, n_val=32, n_test=64,
+                                max_contexts=16)
+    cfg = tiny_config(prefix)
+    model = Code2VecModel(cfg)
+    model.train()
+    return cfg, model, prefix
+
+
+def _attack_for(model, **kw):
+    return GradientRenameAttack(
+        model.dims, model.vocabs.token_vocab, model.vocabs.target_vocab,
+        compute_dtype=model.compute_dtype, **kw)
+
+
+def _test_methods(model, prefix, n):
+    with open(prefix + ".test.c2v", encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()][:n]
+    labels, src, pth, dst, mask, _, _ = parse_c2v_rows(
+        lines, model.vocabs, model.dims.max_contexts)
+    return labels, [(src[i], pth[i], dst[i], mask[i])
+                    for i in range(len(lines))]
+
+
+def test_render_identifier():
+    assert render_identifier("array|index") == "arrayIndex"
+    assert render_identifier("foo") == "foo"
+    assert render_identifier("get|html|body") == "getHtmlBody"
+    assert render_identifier("<PAD>") is None
+    assert render_identifier("a|2b") is None
+
+
+def test_untargeted_attack_flips_predictions(trained):
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=4)
+    _, methods = _test_methods(model, prefix, 12)
+    results = [attack.attack_method(model.params, m, targeted=False,
+                                    max_renames=2) for m in methods]
+    flips = sum(r.success for r in results)
+    # the synthetic corpus ties targets to token identity, so renaming
+    # the decisive tokens must flip most predictions
+    assert flips >= len(results) // 2, \
+        f"only {flips}/{len(results)} untargeted attacks succeeded"
+    for r in results:
+        if r.success:
+            assert r.final_prediction != r.original_prediction
+
+
+def test_attack_trajectory_monotone_and_consistent(trained):
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=4)
+    _, methods = _test_methods(model, prefix, 6)
+    for m in methods:
+        r = attack.attack_method(model.params, m, targeted=False,
+                                 max_renames=1)
+        # every ACCEPTED step must strictly improve the attack loss
+        for s in r.steps:
+            assert s.loss_after < s.loss_before
+        assert r.iterations >= 1
+
+
+def test_targeted_attack_reaches_target(trained):
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=6, top_k_candidates=48)
+    labels, methods = _test_methods(model, prefix, 12)
+    tv = model.vocabs.target_vocab
+    hits = tried = 0
+    for lbl, m in zip(labels, methods):
+        # aim each method at a DIFFERENT class than its ground truth
+        target_id = int(lbl) + 1
+        if target_id >= tv.size:
+            target_id = 2  # first non-special row
+        target = tv.lookup_word(target_id)
+        if target in ("<PAD>", "<OOV>"):
+            continue
+        tried += 1
+        r = attack.attack_method(model.params, m, targeted=True,
+                                 target_name=target, max_renames=3)
+        if r.success:
+            hits += 1
+            assert r.final_prediction == target
+    assert tried >= 8
+    assert hits >= tried // 4, \
+        f"targeted attack hit {hits}/{tried} — gradient guidance broken?"
+
+
+def test_robustness_report(trained):
+    _, model, prefix = trained
+    report = evaluate_robustness(model, prefix + ".test.c2v",
+                                 n_methods=8, max_renames=1,
+                                 max_iters=3, log=lambda *_: None)
+    assert report["n_methods"] > 0
+    assert 0.0 <= report["attack_success_rate"] <= 1.0
+    assert report["robustness"] == pytest.approx(
+        1.0 - report["attack_success_rate"], abs=1e-6)
+    assert 0.0 <= report["clean_top1_acc"] <= 1.0
+
+
+def test_source_helpers():
+    src = "int foo(int barBaz) { return barBaz + quxVal.size(); }"
+    assert identifiers_for_token(src, "bar|baz") == ["barBaz"]
+    # quxVal is never declared here -> not a rename target
+    assert identifiers_for_token(src, "qux|val") == []
+    out = rename_in_source(src, "barBaz", "newName")
+    assert "barBaz" not in out and out.count("newName") == 2
+    dead = insert_dead_declaration(
+        "class A { int go(int x) { return x; } }", "go", "deadVar")
+    assert dead is not None and "int deadVar;" in dead
+    assert insert_dead_declaration("class A {}", "missing", "v") is None
+
+
+def test_declared_variables_heuristic():
+    from code2vec_tpu.attacks.source_attack import declared_variables
+    src = ("class A { int[] items; "
+           "int go(int loVal, String name) { "
+           "int mid = loVal + 1; for (int i = 0; i < mid; i++) "
+           "{ helper(mid); } return mid; } }")
+    decls = declared_variables(src)
+    assert set(decls) == {"items", "loVal", "name", "mid", "i"}
+    # called methods and `return x` never count as declarations
+    assert "helper" not in decls and "go" not in decls
+
+
+def test_dead_declaration_skips_call_sites():
+    # `if (check()) {` is a call followed by a block, not a declaration
+    src = ("class A { void run() { if (check()) { doIt(); } } "
+           "boolean check() { return true; } }")
+    out = insert_dead_declaration(src, "check", "dv", ordinal=0)
+    assert out.index("int dv;") > out.index("boolean check()")
+
+
+def test_dead_declaration_overload_ordinal():
+    src = ("class A { int f(int x) { return x; } "
+           "int f(int x, int y) { return x + y; } }")
+    first = insert_dead_declaration(src, "f", "dv", ordinal=0)
+    second = insert_dead_declaration(src, "f", "dv", ordinal=1)
+    assert first.index("int dv;") < first.index("int f(int x, int y)")
+    assert second.index("int dv;") > second.index("int f(int x, int y)")
+
+
+def test_rename_never_collides_with_method_tokens(trained):
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=4)
+    _, methods = _test_methods(model, prefix, 10)
+    for m in methods:
+        src, _, dst, mask = m
+        present = {model.vocabs.token_vocab.lookup_word(int(t))
+                   for t in np.unique(np.concatenate([src, dst]))}
+        r = attack.attack_method(model.params, m, targeted=False,
+                                 max_renames=1)
+        for s in r.steps:
+            # a new name must not merge with a token the method used
+            assert s.to_token not in present
+
+
+@pytest.mark.skipif(not os.path.exists(EXTRACTOR),
+                    reason="native extractor not built")
+def test_source_level_rename_attack(trained, tmp_path):
+    cfg, model, _ = trained
+    # identifiers drawn from the synthetic vocab so the attack has
+    # in-vocab variables to work with (paths will be OOV — fine)
+    java = tmp_path / "Victim.java"
+    java.write_text(
+        "class Victim {\n"
+        "    int foo(int value, int count) {\n"
+        "        int index = value + count;\n"
+        "        return index * value;\n"
+        "    }\n"
+        "}\n")
+    attack = SourceAttack(cfg, model, max_iters=3)
+    res = attack.attack_file(str(java), targeted=False, max_renames=2)
+    assert res.attack.original_prediction
+    if res.renames:
+        assert res.adversarial_source != java.read_text()
+        for old, new in res.renames.items():
+            assert old not in res.adversarial_source
+            assert new in res.adversarial_source
+        # the driver re-extracted and re-predicted the rewritten source
+        assert isinstance(res.verified_prediction, str)
+
+
+@pytest.mark.skipif(not os.path.exists(EXTRACTOR),
+                    reason="native extractor not built")
+def test_source_level_deadcode_attack(trained, tmp_path):
+    cfg, model, _ = trained
+    java = tmp_path / "Dead.java"
+    java.write_text(
+        "class Dead {\n"
+        "    int foo(int value, int count) {\n"
+        "        int index = value + count;\n"
+        "        return index;\n"
+        "    }\n"
+        "}\n")
+    attack = SourceAttack(cfg, model, max_iters=3)
+    res = attack.attack_file(str(java), targeted=False, deadcode=True)
+    # dead-code mode only ever touches the inserted declaration: the
+    # original program text survives in the adversarial source
+    if res.adversarial_source is not None:
+        for line in ("int index = value + count;", "return index;"):
+            assert line in res.adversarial_source
+        assert "int " in res.adversarial_source
