@@ -102,7 +102,10 @@ def main() -> int:
             max_renames=config.ATTACK_MAX_RENAMES,
             deadcode=config.ATTACK_DEADCODE)
         print(str(result))
-        if result.adversarial_source is not None:
+        # only a VERIFIED success earns the .adversarial artifact —
+        # scripts treat the file's existence as the success signal
+        if result.adversarial_source is not None and \
+                result.verified_success:
             dest = config.ATTACK_INPUT + ".adversarial"
             with open(dest, "w", encoding="utf-8") as f:
                 f.write(result.adversarial_source)

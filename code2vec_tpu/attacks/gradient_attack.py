@@ -47,6 +47,16 @@ from code2vec_tpu.models.encoder import (ModelDims, full_logits,
 from code2vec_tpu.vocab.vocabularies import Vocab
 
 _LETTERS_RE = re.compile(r"^[a-z]+$")
+# Reserved words are not identifiers: a rename to `while` would emit
+# invalid source. Java's set (+ `var`/`String`, which would shadow);
+# applied to all frontends — mildly over-restrictive for Python, safe.
+JAVA_KEYWORDS = frozenset(
+    "abstract assert boolean break byte case catch char class const "
+    "continue default do double else enum extends final finally float "
+    "for goto if implements import instanceof int interface long native "
+    "new package private protected public return short static strictfp "
+    "super switch synchronized this throw throws transient try void "
+    "volatile while true false null var string".split())
 
 
 def render_identifier(token_word: str) -> Optional[str]:
@@ -54,12 +64,16 @@ def render_identifier(token_word: str) -> Optional[str]:
 
     Vocab tokens are normalized subtoken strings (`array|index`); the
     source-level rename needs a real identifier (`arrayIndex`). Only
-    all-letter subtokens render — anything else could not have come from
-    a plain identifier and is excluded from the candidate pool."""
+    all-letter subtokens render, and reserved words are rejected —
+    anything else could not be a plain identifier and is excluded from
+    the candidate pool."""
     subs = token_word.split("|")
     if not subs or any(not _LETTERS_RE.match(s) for s in subs):
         return None
-    return subs[0] + "".join(s.capitalize() for s in subs[1:])
+    ident = subs[0] + "".join(s.capitalize() for s in subs[1:])
+    if ident.lower() in JAVA_KEYWORDS:
+        return None
+    return ident
 
 
 def candidate_mask(token_vocab: Vocab, padded_rows: int) -> np.ndarray:
@@ -327,18 +341,26 @@ class GradientRenameAttack:
                       target_name: Optional[str] = None,
                       max_renames: int = 1,
                       token_ids: Optional[Sequence[int]] = None,
-                      forbidden: frozenset = frozenset()
+                      forbidden: frozenset = frozenset(),
+                      baseline_top1: Optional[int] = None
                       ) -> AttackResult:
         """Attack one tensorized method: greedily rename up to
         `max_renames` variables (most-frequent first, or the explicit
         `token_ids`), carrying successful renames forward. `forbidden`
         ids are never used as new names (the source driver passes every
-        identifier already present in the file)."""
+        identifier already present in the file). `baseline_top1`
+        overrides the untargeted reference prediction — the dead-code
+        driver passes the PRISTINE file's top-1 so 'flipped' means
+        'differs from the original program', not 'differs from the
+        placeholder-inserted variant'."""
         src, pth, dst, mask = (np.asarray(a) for a in method)
         ids0 = (jnp.asarray(src), jnp.asarray(pth), jnp.asarray(dst),
                 jnp.asarray(mask))
-        top1_0, _ = self.predict_fn(params, ids0)
-        original_top1 = int(top1_0)
+        if baseline_top1 is None:
+            top1_0, _ = self.predict_fn(params, ids0)
+            original_top1 = int(top1_0)
+        else:
+            original_top1 = int(baseline_top1)
         if targeted:
             if target_name is None:
                 raise ValueError("targeted attack needs a target name")

@@ -42,8 +42,11 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
         compute_dtype=model.compute_dtype)
     tv = model.vocabs.target_vocab
 
+    import itertools
     with open(test_path, encoding="utf-8") as f:
-        lines = [ln for ln in f if ln.strip()][:n_methods]
+        # islice: production splits are GBs; read only what is attacked
+        lines = list(itertools.islice(
+            (ln for ln in f if ln.strip()), n_methods))
     labels, src, pth, dst, mask, tstr, _ = parse_c2v_rows(
         lines, model.vocabs, model.dims.max_contexts, keep_strings=True)
 

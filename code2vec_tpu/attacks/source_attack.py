@@ -46,14 +46,12 @@ from code2vec_tpu.common import split_to_subtokens
 from code2vec_tpu.data.reader import parse_c2v_rows
 from code2vec_tpu.serving.extractor import Extractor
 
+from code2vec_tpu.attacks.gradient_attack import JAVA_KEYWORDS
+
 _IDENT_RE = re.compile(r"\b[A-Za-z_][A-Za-z0-9_]*\b")
-_JAVA_KEYWORDS = frozenset(
-    "abstract assert boolean break byte case catch char class const "
-    "continue default do double else enum extends final finally float "
-    "for goto if implements import instanceof int interface long native "
-    "new package private protected public return short static strictfp "
-    "super switch synchronized this throw throws transient try void "
-    "volatile while true false null var String".split())
+# one keyword list (gradient_attack.JAVA_KEYWORDS, lowercase) + the
+# exact-case type name the identifier scanner must also skip
+_JAVA_KEYWORDS = JAVA_KEYWORDS | {"String"}
 # keywords that may legally precede an identifier but are NOT types —
 # `return index;` must not read as a declaration of `index`
 _NOT_A_TYPE = frozenset(
@@ -207,6 +205,13 @@ class SourceAttack:
         ordinal = names[:method_index].count(method_name)
 
         if deadcode:
+            # baseline: the PRISTINE file's prediction — success must
+            # mean "differs from the original program", and inserting
+            # the placeholder alone can already move the prediction
+            _, pristine = self._tensorize(lines[method_index])
+            import jax.numpy as jnp
+            p_ids = tuple(jnp.asarray(a) for a in pristine)
+            p_top1, _ = self.attack.predict_fn(self.model.params, p_ids)
             var0 = self._fresh_variable_name(source)
             mod = insert_dead_declaration(source, method_name, var0,
                                           ordinal)
@@ -216,7 +221,7 @@ class SourceAttack:
                     f"to insert dead code")
             return self._run(mod, method_name, ordinal, targeted,
                              target_name, token_ids_from=var0,
-                             max_renames=1)
+                             max_renames=1, baseline_top1=int(p_top1))
         return self._run(source, method_name, ordinal, targeted,
                          target_name, token_ids_from=None,
                          max_renames=max_renames,
@@ -261,8 +266,8 @@ class SourceAttack:
     def _run(self, source: str, method_name: str, ordinal: int,
              targeted: bool, target_name: Optional[str],
              token_ids_from: Optional[str], max_renames: int,
-             extraction: Optional[Tuple[List[str], List[str]]] = None
-             ) -> SourceAttackResult:
+             extraction: Optional[Tuple[List[str], List[str]]] = None,
+             baseline_top1: Optional[int] = None) -> SourceAttackResult:
         names, lines = (extraction if extraction is not None
                         else self._extract_lines_of(source))
         idx = self._method_row(names, method_name, ordinal)
@@ -284,7 +289,8 @@ class SourceAttack:
             self.model.params, method, targeted=targeted,
             target_name=target_name, max_renames=max_renames,
             token_ids=token_ids,
-            forbidden=self._forbidden_ids(source))
+            forbidden=self._forbidden_ids(source),
+            baseline_top1=baseline_top1)
 
         renames: Dict[str, str] = {}
         adv_source = source

@@ -110,6 +110,12 @@ def make_eval_step(dims: ModelDims, *, top_k: int = 10,
                              use_pallas=use_pallas)
         logits = full_logits(params, code, dims.target_vocab_size)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        # CE is mathematically >= 0; on TPU the logsumexp-minus-logit
+        # difference can come out a hair negative for near-zero-loss
+        # examples (different reduction paths), which makes the REPORTED
+        # eval loss print as e.g. -0.019 on overfit tiny runs. Clamp —
+        # this is an eval-only metric, no gradients flow through it.
+        ce = jnp.maximum(ce, 0.0)
         loss_sum = jnp.sum(ce * weights)
         probs = jax.nn.softmax(logits, axis=-1)
         topk_probs, topk_ids = jax.lax.top_k(probs, top_k)

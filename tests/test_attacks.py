@@ -56,6 +56,10 @@ def test_render_identifier():
     assert render_identifier("get|html|body") == "getHtmlBody"
     assert render_identifier("<PAD>") is None
     assert render_identifier("a|2b") is None
+    # reserved words are not identifiers — `int while;` is not Java
+    assert render_identifier("while") is None
+    assert render_identifier("int") is None
+    assert render_identifier("string") is None
 
 
 def test_untargeted_attack_flips_predictions(trained):
