@@ -1,0 +1,80 @@
+"""Adversarial-training defense: random variable-rename augmentation.
+
+Reference parity target: the defense evaluated in "Adversarial Examples
+for Models of Code" (Yefet, Alon & Yahav 2020 — the `noamyft/code2vec`
+fork delta, SURVEY.md §0 item 2): training on rename-perturbed programs
+makes the model invariant to the attack's manipulation surface. The
+paper's strongest defense retrains on adversarially-perturbed examples;
+the shipped, cheap approximation is its randomized form — each training
+example, with probability p (`--adv_rename_prob`), has one of its
+variables renamed to a random legal token, occurrences replaced
+consistently. This is the same manipulation the attack performs, minus
+the gradient guidance, and runs entirely inside the jitted train step
+(two categorical draws and a masked `where` per example — no host work,
+no extractor in the loop).
+
+Measured effect: tools/robustness_study.py trains matched
+baseline/defended models and attacks both; results in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.attacks.gradient_attack import candidate_mask
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.vocab.vocabularies import Vocab
+
+
+def legal_token_ids(token_vocab: Vocab, dims: ModelDims) -> np.ndarray:
+    """int32 [L] vocab rows usable as random replacement names (real,
+    identifier-renderable tokens — same pool the attack draws from)."""
+    mask = candidate_mask(token_vocab, dims.padded(dims.token_vocab_size))
+    ids = np.nonzero(mask)[0].astype(np.int32)
+    if len(ids) == 0:
+        raise ValueError("no legal rename tokens in the vocabulary")
+    return ids
+
+
+def make_rename_augment(legal_ids: np.ndarray, prob: float,
+                        padded_rows: int) -> Callable:
+    """Returns jit-safe `augment(batch, rng) -> batch`.
+
+    Per example: pick one valid context slot whose source token is a
+    LEGAL identifier token (same candidate_mask pool the attack uses —
+    never OOV/PAD/literal tokens, whose occurrences span many distinct
+    source identifiers and would over-perturb), then with probability
+    `prob` replace ALL occurrences of that token in the example's
+    src/dst slots with one uniformly-drawn legal token. Collisions with
+    tokens the example already uses are allowed — augmentation is noise
+    injection, not a validity-checked attack. Examples with no legal
+    slot are left unchanged."""
+    legal = jnp.asarray(legal_ids)
+    mask_np = np.zeros((padded_rows,), dtype=bool)
+    mask_np[legal_ids] = True
+    legal_mask = jnp.asarray(mask_np)
+
+    def augment(batch, rng):
+        labels, src, pth, dst, mask, weights = batch
+        B = src.shape[0]
+        r_slot, r_new, r_apply = jax.random.split(rng, 3)
+        # one valid, legal-token slot per example (all-padding rows have
+        # weight 0 — whatever categorical returns there is never counted)
+        eligible = (mask > 0) & legal_mask[src]
+        slot_logits = jnp.where(eligible, 0.0, -1e9)
+        j = jax.random.categorical(r_slot, slot_logits, axis=-1)
+        tok = jnp.take_along_axis(src, j[:, None], axis=1)[:, 0]
+        new = legal[jax.random.randint(r_new, (B,), 0, legal.shape[0])]
+        keep = (jax.random.bernoulli(r_apply, prob, (B,))
+                & legal_mask[tok])  # no-legal-slot rows stay unchanged
+        # a non-id sentinel disables the rename where keep is False
+        tok_eff = jnp.where(keep, tok, -1)[:, None]
+        src2 = jnp.where(src == tok_eff, new[:, None], src)
+        dst2 = jnp.where(dst == tok_eff, new[:, None], dst)
+        return labels, src2, pth, dst2, mask, weights
+
+    return augment

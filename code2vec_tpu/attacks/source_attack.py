@@ -309,6 +309,20 @@ class SourceAttack:
                 renames[ident] = new_ident
 
         verified_pred = verified_ok = None
+        if not renames and token_ids_from is not None and result.success:
+            # The placeholder insertion ALONE flipped the prediction —
+            # the inserted-declaration source is itself the adversarial
+            # example. It was already extracted and predicted in this
+            # run (that is where `result` came from), so the verified
+            # outcome is exactly the final prediction on it.
+            verified_pred = result.final_prediction
+            verified_ok = (verified_pred == target_name if targeted
+                           else verified_pred
+                           != result.original_prediction)
+            return SourceAttackResult(
+                attack=result, renames={}, adversarial_source=source,
+                verified_prediction=verified_pred,
+                verified_success=verified_ok)
         if renames:
             try:
                 v_names, v_lines = self._extract_lines_of(adv_source)

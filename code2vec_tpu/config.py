@@ -165,6 +165,11 @@ class Config:
     ATTACK_DEADCODE: bool = False         # insert `int <adv>;` instead
     ATTACK_TOPK: int = 32                 # exact-rescore shortlist size
     ATTACK_ITERS: int = 4                 # rename iterations / variable
+    # Adversarial-training defense (attacks/defense.py): with this
+    # probability each training example has one variable renamed to a
+    # random legal token (occurrences replaced consistently) inside the
+    # jitted train step. 0 disables (reference parity).
+    ADV_RENAME_PROB: float = 0.0
 
     def __post_init__(self) -> None:
         if self.TARGET_EMBEDDINGS_SIZE is None:
@@ -324,6 +329,11 @@ class Config:
                        default=None)
         p.add_argument("--attack_iters", dest="attack_iters", type=int,
                        default=None)
+        p.add_argument("--adv_rename_prob", dest="adv_rename_prob",
+                       type=float, default=None,
+                       help="adversarial-training defense: probability "
+                            "of randomly renaming one variable per "
+                            "training example")
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
 
@@ -415,6 +425,8 @@ class Config:
             cfg.ATTACK_TOPK = ns.attack_topk
         if ns.attack_iters is not None:
             cfg.ATTACK_ITERS = ns.attack_iters
+        if ns.adv_rename_prob is not None:
+            cfg.ADV_RENAME_PROB = ns.adv_rename_prob
         if ns.verbose_mode is not None:
             cfg.VERBOSE_MODE = ns.verbose_mode
         cfg.verify()
@@ -460,6 +472,17 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES supports the bag encoder only "
                 "(sparse_steps.py trains no transformer params).")
+        if not 0.0 <= self.ADV_RENAME_PROB <= 1.0:
+            raise ValueError("--adv_rename_prob must be in [0, 1].")
+        if self.ADV_RENAME_PROB > 0 and self.SPARSE_EMBEDDING_UPDATES:
+            raise ValueError(
+                "--adv_rename_prob is not supported with "
+                "SPARSE_EMBEDDING_UPDATES (the sparse step has no "
+                "augmentation hook).")
+        if self.ADV_RENAME_PROB > 0 and self.HEAD == "varmisuse":
+            raise ValueError(
+                "--adv_rename_prob applies to the code2vec head only "
+                "(the varmisuse train step has no augmentation hook).")
         if self.ATTACK and not self.is_loading:
             raise ValueError("--attack requires --load.")
         if self.ATTACK == "targeted" and not self.ATTACK_TARGET:

@@ -161,12 +161,22 @@ class Code2VecModel(Code2VecModelBase):
                 num_sampled=cfg.NUM_SAMPLED_CLASSES,
                 compute_dtype=self.compute_dtype)
         else:
+            augment_fn = None
+            if cfg.ADV_RENAME_PROB > 0:
+                # adversarial-training defense (attacks/defense.py)
+                from code2vec_tpu.attacks.defense import (
+                    legal_token_ids, make_rename_augment)
+                augment_fn = make_rename_augment(
+                    legal_token_ids(self.vocabs.token_vocab, self.dims),
+                    cfg.ADV_RENAME_PROB,
+                    self.dims.padded(self.dims.token_vocab_size))
             self._train_step = make_train_step(
                 self.dims, self.optimizer,
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
                 num_sampled=cfg.NUM_SAMPLED_CLASSES,
                 compute_dtype=self.compute_dtype,
-                use_pallas=self.use_pallas, mesh=self.mesh)
+                use_pallas=self.use_pallas, mesh=self.mesh,
+                augment_fn=augment_fn)
         top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
         self._eval_step = make_eval_step(self.dims, top_k=top_k,
                                          compute_dtype=self.compute_dtype,
@@ -396,7 +406,9 @@ class Code2VecModel(Code2VecModelBase):
                  # always the EFFECTIVE schedule: for loaded models the
                  # manifest override already set cfg.LR_SCHEDULE to what
                  # the saved opt_state structure carries
-                 "lr_schedule": self.config.LR_SCHEDULE}
+                 "lr_schedule": self.config.LR_SCHEDULE,
+                 # provenance only (no structural effect on restore)
+                 "adv_rename_prob": self.config.ADV_RENAME_PROB}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

@@ -73,11 +73,15 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
                     num_sampled: int = 4096,
                     compute_dtype=jnp.float32,
                     use_pallas: bool = False,
-                    mesh=None) -> Callable:
+                    mesh=None,
+                    augment_fn: Callable = None) -> Callable:
     """Returns jitted `step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)` where batch is a 6-tuple of arrays
     (labels [B], src/path/dst ids [B, C], mask [B, C],
-    example_weights [B])."""
+    example_weights [B]). `augment_fn(batch, rng) -> batch` is an
+    optional train-only input transform (the --adv_rename_prob
+    adversarial-training defense, attacks/defense.py); it runs inside
+    the jit, before the loss."""
 
     loss_fn = make_train_loss_fn(
         dims, use_sampled_softmax=use_sampled_softmax,
@@ -86,6 +90,9 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
+        if augment_fn is not None:
+            rng, aug_rng = jax.random.split(rng)
+            batch = augment_fn(batch, aug_rng)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -185,6 +185,60 @@ def test_rename_never_collides_with_method_tokens(trained):
             assert s.to_token not in present
 
 
+def test_rename_augment_semantics(trained):
+    import jax
+    import jax.numpy as jnp
+    from code2vec_tpu.attacks.defense import (legal_token_ids,
+                                              make_rename_augment)
+    _, model, prefix = trained
+    _, methods = _test_methods(model, prefix, 4)
+    src = np.stack([m[0] for m in methods])
+    pth = np.stack([m[1] for m in methods])
+    dst = np.stack([m[2] for m in methods])
+    mask = np.stack([m[3] for m in methods])
+    labels = np.zeros((len(methods),), np.int32)
+    weights = np.ones((len(methods),), np.float32)
+    batch = tuple(jnp.asarray(a)
+                  for a in (labels, src, pth, dst, mask, weights))
+    legal = legal_token_ids(model.vocabs.token_vocab, model.dims)
+    rows = model.dims.padded(model.dims.token_vocab_size)
+
+    # p=0: identity
+    out0 = make_rename_augment(legal, 0.0, rows)(
+        batch, jax.random.PRNGKey(0))
+    assert np.array_equal(np.asarray(out0[1]), src)
+    assert np.array_equal(np.asarray(out0[3]), dst)
+
+    # p=1: one token per example renamed; occurrences consistent
+    out1 = make_rename_augment(legal, 1.0, rows)(
+        batch, jax.random.PRNGKey(1))
+    src1, dst1 = np.asarray(out1[1]), np.asarray(out1[3])
+    for i in range(len(methods)):
+        changed = src[i] != src1[i]
+        if not changed.any():
+            continue  # renamed token can collide with itself
+        old = np.unique(src[i][changed])
+        new = np.unique(src1[i][changed])
+        assert len(old) == 1 and len(new) == 1  # ONE variable renamed
+        # every occurrence moved, on both sides
+        assert not (src1[i] == old[0]).any()
+        assert not (dst1[i] == old[0]).any()
+        assert int(new[0]) in legal
+        assert int(old[0]) in legal  # never renames OOV/PAD/literals
+    # labels/paths/mask untouched
+    assert np.array_equal(np.asarray(out1[2]), pth)
+    assert np.array_equal(np.asarray(out1[4]), mask)
+
+
+def test_adversarial_training_converges(trained):
+    _, _, prefix = trained
+    cfg = tiny_config(prefix, ADV_RENAME_PROB=0.3)
+    model = Code2VecModel(cfg)
+    model.train()
+    res = model.evaluate()
+    assert res.subtoken_f1 > 0.5  # augmented training still learns
+
+
 @pytest.mark.skipif(not os.path.exists(EXTRACTOR),
                     reason="native extractor not built")
 def test_source_level_rename_attack(trained, tmp_path):

@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Attack-vs-defense study: does --adv_rename_prob buy robustness?
+
+Trains two matched models on a gen_java_corpus dataset — baseline
+(reference training) and defended (--adv_rename_prob, the randomized
+rename-augmentation defense from attacks/defense.py) — then attacks
+both with the untargeted gradient rename attack (attacks/robustness.py)
+and reports clean quality next to attack success rate. Results recorded
+in BASELINE.md ("Adversarial robustness" section).
+
+Usage (corpus build is the quality-study recipe):
+  python tools/gen_java_corpus.py --out /tmp/rs/raw --names 10000 \
+      --methods 100000
+  TRAIN_DIR=/tmp/rs/raw/train VAL_DIR=/tmp/rs/raw/val \
+      TEST_DIR=/tmp/rs/raw/test DATASET_NAME=rs OUT_DIR=/tmp/rs/ds \
+      WORD_VOCAB_SIZE=150000 PATH_VOCAB_SIZE=150000 \
+      TARGET_VOCAB_SIZE=60000 ./preprocess.sh
+  python tools/robustness_study.py --data /tmp/rs/ds/rs --epochs 6 \
+      --n_attacks 300 --adv_prob 0.3
+Prints one JSON line per arm and a summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_arm(name: str, data: str, epochs: int, batch: int,
+            adv_prob: float, n_attacks: int, max_renames: int,
+            seed: int, max_contexts: int) -> dict:
+    from code2vec_tpu.attacks.robustness import evaluate_robustness
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.models.jax_model import Code2VecModel
+
+    # the shipped java-large-style config (sampled + bf16 + adafactor)
+    cfg = Config(
+        MAX_CONTEXTS=max_contexts,
+        MAX_TOKEN_VOCAB_SIZE=150_000,
+        MAX_PATH_VOCAB_SIZE=150_000,
+        MAX_TARGET_VOCAB_SIZE=60_000,
+        TRAIN_BATCH_SIZE=batch,
+        TEST_BATCH_SIZE=batch,
+        NUM_TRAIN_EPOCHS=epochs,
+        SAVE_EVERY_EPOCHS=1000,
+        NUM_BATCHES_TO_LOG_PROGRESS=200,
+        LEARNING_RATE=1e-3,
+        SEED=seed,
+        USE_SAMPLED_SOFTMAX=True,
+        NUM_SAMPLED_CLASSES=4096,
+        ADV_RENAME_PROB=adv_prob,
+    )
+    cfg.train_data_path = data
+    cfg.test_data_path = data + ".val.c2v"
+    model = Code2VecModel(cfg)
+    t0 = time.time()
+    model.train()
+    train_s = time.time() - t0
+    clean = model.evaluate()
+    rob = evaluate_robustness(model, data + ".val.c2v",
+                              n_methods=n_attacks,
+                              max_renames=max_renames, log=cfg.log)
+    row = {
+        "arm": name,
+        "adv_rename_prob": adv_prob,
+        "epochs": epochs,
+        "clean_subtoken_f1": round(clean.subtoken_f1, 4),
+        "clean_top1": round(clean.topk_acc[0], 4),
+        "attack_success_rate": rob["attack_success_rate"],
+        "robustness": rob["robustness"],
+        "attacked_top1_acc": rob["attacked_top1_acc"],
+        "n_attacks": rob["n_methods"],
+        "train_seconds": round(train_s, 1),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True,
+                    help="dataset prefix (expects .train/.val .c2v)")
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--adv_prob", type=float, default=0.3)
+    ap.add_argument("--n_attacks", type=int, default=300)
+    ap.add_argument("--max_renames", type=int, default=1)
+    ap.add_argument("--max_contexts", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--arms", default="baseline,defended",
+                    help="comma list: baseline | defended")
+    a = ap.parse_args()
+
+    rows = []
+    for arm in a.arms.split(","):
+        prob = 0.0 if arm == "baseline" else a.adv_prob
+        rows.append(run_arm(arm, a.data, a.epochs, a.batch, prob,
+                            a.n_attacks, a.max_renames, a.seed,
+                            a.max_contexts))
+    print(f"\n{'arm':<10} {'p':>4} {'cleanF1':>8} {'top1':>6} "
+          f"{'atk-success':>11} {'atk-top1':>8}")
+    for r in rows:
+        print(f"{r['arm']:<10} {r['adv_rename_prob']:>4} "
+              f"{r['clean_subtoken_f1']:>8} {r['clean_top1']:>6} "
+              f"{r['attack_success_rate']:>11} "
+              f"{r['attacked_top1_acc']:>8}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
