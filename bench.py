@@ -154,10 +154,16 @@ def _measure_fwd_bwd_floor():
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
     def chain(n, rng):
+        # keys pre-split OUTSIDE the timed region: each jax.random.split
+        # is its own dispatch, and on the tunneled platform dispatches
+        # cost ~2 ms each — splitting in the loop would double the
+        # per-step dispatch overhead the slope can't cancel.
+        rng, sub = jax.random.split(rng)
+        keys = list(jax.random.split(sub, max(n, 1)))
         t0 = time.perf_counter()
         for i in range(n):
-            rng, k = jax.random.split(rng)
-            loss, _g = grad_fn(params, batches[i % len(batches)], k)
+            loss, _g = grad_fn(params, batches[i % len(batches)],
+                               keys[i])
         float(loss)
         return time.perf_counter() - t0, rng
 
@@ -188,13 +194,18 @@ def _measure_encoder(encoder_type: str):
 
     def chain(n, state):
         """Run n chained steps; the donated-params chain serializes
-        them, so the final host transfer bounds the full computation."""
+        them, so the final host transfer bounds the full computation.
+        RNG keys are pre-split outside the timed region (a split per
+        step would add a second ~2 ms dispatch per iteration on the
+        tunneled platform — overhead the slope cannot cancel)."""
         params, opt_state, rng = state
+        rng, sub = jax.random.split(rng)
+        keys = list(jax.random.split(sub, max(n, 1)))
         t0 = time.perf_counter()
         for i in range(n):
-            rng, k = jax.random.split(rng)
             params, opt_state, loss = step(params, opt_state,
-                                           batches[i % len(batches)], k)
+                                           batches[i % len(batches)],
+                                           keys[i])
         float(loss)
         return time.perf_counter() - t0, (params, opt_state, rng)
 

@@ -10,8 +10,9 @@ example, with probability p (`--adv_rename_prob`), has one of its
 variables renamed to a random legal token, occurrences replaced
 consistently. This is the same manipulation the attack performs, minus
 the gradient guidance, and runs entirely inside the jitted train step
-(two categorical draws and a masked `where` per example — no host work,
-no extractor in the loop).
+(per batch: one categorical slot draw, one uniform replacement draw,
+one bernoulli gate, then masked `where`s — no host work, no extractor
+in the loop).
 
 Measured effect: tools/robustness_study.py trains matched
 baseline/defended models and attacks both; results in BASELINE.md.
@@ -30,18 +31,17 @@ from code2vec_tpu.models.encoder import ModelDims
 from code2vec_tpu.vocab.vocabularies import Vocab
 
 
-def legal_token_ids(token_vocab: Vocab, dims: ModelDims) -> np.ndarray:
-    """int32 [L] vocab rows usable as random replacement names (real,
-    identifier-renderable tokens — same pool the attack draws from)."""
+def legal_token_mask(token_vocab: Vocab, dims: ModelDims) -> np.ndarray:
+    """bool [padded_rows] — True where a vocab row is usable as a random
+    replacement name (real, identifier-renderable tokens — same pool the
+    attack draws from)."""
     mask = candidate_mask(token_vocab, dims.padded(dims.token_vocab_size))
-    ids = np.nonzero(mask)[0].astype(np.int32)
-    if len(ids) == 0:
+    if not mask.any():
         raise ValueError("no legal rename tokens in the vocabulary")
-    return ids
+    return mask
 
 
-def make_rename_augment(legal_ids: np.ndarray, prob: float,
-                        padded_rows: int) -> Callable:
+def make_rename_augment(legal: np.ndarray, prob: float) -> Callable:
     """Returns jit-safe `augment(batch, rng) -> batch`.
 
     Per example: pick one valid context slot whose source token is a
@@ -52,11 +52,10 @@ def make_rename_augment(legal_ids: np.ndarray, prob: float,
     src/dst slots with one uniformly-drawn legal token. Collisions with
     tokens the example already uses are allowed — augmentation is noise
     injection, not a validity-checked attack. Examples with no legal
-    slot are left unchanged."""
-    legal = jnp.asarray(legal_ids)
-    mask_np = np.zeros((padded_rows,), dtype=bool)
-    mask_np[legal_ids] = True
-    legal_mask = jnp.asarray(mask_np)
+    slot are left unchanged. `legal` is the bool [padded_rows] mask from
+    legal_token_mask."""
+    legal_mask = jnp.asarray(legal)
+    legal = jnp.asarray(np.nonzero(legal)[0].astype(np.int32))
 
     def augment(batch, rng):
         labels, src, pth, dst, mask, weights = batch

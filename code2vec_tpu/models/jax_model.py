@@ -165,11 +165,10 @@ class Code2VecModel(Code2VecModelBase):
             if cfg.ADV_RENAME_PROB > 0:
                 # adversarial-training defense (attacks/defense.py)
                 from code2vec_tpu.attacks.defense import (
-                    legal_token_ids, make_rename_augment)
+                    legal_token_mask, make_rename_augment)
                 augment_fn = make_rename_augment(
-                    legal_token_ids(self.vocabs.token_vocab, self.dims),
-                    cfg.ADV_RENAME_PROB,
-                    self.dims.padded(self.dims.token_vocab_size))
+                    legal_token_mask(self.vocabs.token_vocab, self.dims),
+                    cfg.ADV_RENAME_PROB)
             self._train_step = make_train_step(
                 self.dims, self.optimizer,
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,

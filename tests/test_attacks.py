@@ -188,7 +188,7 @@ def test_rename_never_collides_with_method_tokens(trained):
 def test_rename_augment_semantics(trained):
     import jax
     import jax.numpy as jnp
-    from code2vec_tpu.attacks.defense import (legal_token_ids,
+    from code2vec_tpu.attacks.defense import (legal_token_mask,
                                               make_rename_augment)
     _, model, prefix = trained
     _, methods = _test_methods(model, prefix, 4)
@@ -200,18 +200,15 @@ def test_rename_augment_semantics(trained):
     weights = np.ones((len(methods),), np.float32)
     batch = tuple(jnp.asarray(a)
                   for a in (labels, src, pth, dst, mask, weights))
-    legal = legal_token_ids(model.vocabs.token_vocab, model.dims)
-    rows = model.dims.padded(model.dims.token_vocab_size)
+    legal = legal_token_mask(model.vocabs.token_vocab, model.dims)
 
     # p=0: identity
-    out0 = make_rename_augment(legal, 0.0, rows)(
-        batch, jax.random.PRNGKey(0))
+    out0 = make_rename_augment(legal, 0.0)(batch, jax.random.PRNGKey(0))
     assert np.array_equal(np.asarray(out0[1]), src)
     assert np.array_equal(np.asarray(out0[3]), dst)
 
     # p=1: one token per example renamed; occurrences consistent
-    out1 = make_rename_augment(legal, 1.0, rows)(
-        batch, jax.random.PRNGKey(1))
+    out1 = make_rename_augment(legal, 1.0)(batch, jax.random.PRNGKey(1))
     src1, dst1 = np.asarray(out1[1]), np.asarray(out1[3])
     for i in range(len(methods)):
         changed = src[i] != src1[i]
@@ -223,8 +220,8 @@ def test_rename_augment_semantics(trained):
         # every occurrence moved, on both sides
         assert not (src1[i] == old[0]).any()
         assert not (dst1[i] == old[0]).any()
-        assert int(new[0]) in legal
-        assert int(old[0]) in legal  # never renames OOV/PAD/literals
+        assert legal[int(new[0])]
+        assert legal[int(old[0])]  # never renames OOV/PAD/literals
     # labels/paths/mask untouched
     assert np.array_equal(np.asarray(out1[2]), pth)
     assert np.array_equal(np.asarray(out1[4]), mask)

@@ -97,8 +97,12 @@ def main() -> int:
                     help="comma list: baseline | defended")
     a = ap.parse_args()
 
+    arms = [s.strip() for s in a.arms.split(",")]
+    bad = [s for s in arms if s not in ("baseline", "defended")]
+    if bad:
+        ap.error(f"unknown arm(s) {bad}; valid: baseline, defended")
     rows = []
-    for arm in a.arms.split(","):
+    for arm in arms:
         prob = 0.0 if arm == "baseline" else a.adv_prob
         rows.append(run_arm(arm, a.data, a.epochs, a.batch, prob,
                             a.n_attacks, a.max_renames, a.seed,
