@@ -48,15 +48,20 @@ from code2vec_tpu.vocab.vocabularies import Vocab
 
 _LETTERS_RE = re.compile(r"^[a-z]+$")
 # Reserved words are not identifiers: a rename to `while` would emit
-# invalid source. Java's set (+ `var`/`String`, which would shadow);
-# applied to all frontends — mildly over-restrictive for Python, safe.
+# invalid source. Java's set (+ `var`/`String`, which would shadow)
+# united with Python's (both frontends share the candidate pool;
+# keywords are all lowercase single words, so only single-subtoken
+# tokens can collide — camelCase renders never do).
 JAVA_KEYWORDS = frozenset(
     "abstract assert boolean break byte case catch char class const "
     "continue default do double else enum extends final finally float "
     "for goto if implements import instanceof int interface long native "
     "new package private protected public return short static strictfp "
     "super switch synchronized this throw throws transient try void "
-    "volatile while true false null var string".split())
+    "volatile while true false null var string "
+    # Python reserved / soft-reserved words
+    "and as async await def del elif except from global import in is "
+    "lambda nonlocal not or pass raise with yield none match self".split())
 
 
 def render_identifier(token_word: str) -> Optional[str]:

@@ -84,10 +84,49 @@ def declared_variables(source: str) -> List[str]:
     return out
 
 
+def declared_variables_python(source: str) -> List[str]:
+    """Python counterpart of declared_variables, via the real parser
+    (the python frontend itself uses CPython `ast` — SURVEY.md §8.3
+    step 8): function params plus assignment / for / with / comprehension
+    binding targets. Called functions and attribute names never bind
+    here; together with rename_in_source_python's AST-precise rewrite
+    the Python rename path stays semantics-preserving."""
+    import ast
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out, seen = [], set()
+
+    def add(name: str) -> None:
+        if name not in seen and not name.startswith("__"):
+            seen.add(name)
+            out.append(name)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                add(arg.arg)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                       ast.Store):
+            add(node.id)
+    return out
+
+
+def declared_for(source: str, language: str) -> List[str]:
+    """Declaration-position identifiers, per source language."""
+    return (declared_variables_python(source) if language == "python"
+            else declared_variables(source))
+
+
 def identifiers_for_token(source: str, token_word: str,
-                          declared_only: bool = True) -> List[str]:
+                          declared_only: bool = True,
+                          language: str = "java") -> List[str]:
     """Source identifiers that normalize to the stored vocab token."""
-    pool = (declared_variables(source) if declared_only else
+    pool = (declared_for(source, language) if declared_only else
             [m.group(0) for m in _IDENT_RE.finditer(source)
              if m.group(0) not in _JAVA_KEYWORDS])
     found, seen = [], set()
@@ -100,6 +139,33 @@ def identifiers_for_token(source: str, token_word: str,
 
 def rename_in_source(source: str, old_ident: str, new_ident: str) -> str:
     return re.sub(rf"\b{re.escape(old_ident)}\b", new_ident, source)
+
+
+def rename_in_source_python(source: str, old_ident: str,
+                            new_ident: str) -> str:
+    """AST-precise Python rename: rewrites only `Name` nodes and
+    function-parameter `arg` nodes whose identifier matches — never
+    keyword-argument NAMES in calls (`fetch(timeout=x)` keeps its
+    `timeout=`, which belongs to the callee), attribute names, or
+    string contents. This is what keeps Python renames
+    semantics-preserving where a word-boundary regex is not."""
+    import ast
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return rename_in_source(source, old_ident, new_ident)
+    spots = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Name) and node.id == old_ident) or \
+                (isinstance(node, ast.arg) and node.arg == old_ident):
+            spots.append((node.lineno, node.col_offset))
+    lines = source.splitlines(keepends=True)
+    for ln, col in sorted(spots, reverse=True):
+        line = lines[ln - 1]
+        if line[col:col + len(old_ident)] == old_ident:
+            lines[ln - 1] = (line[:col] + new_ident
+                             + line[col + len(old_ident):])
+    return "".join(lines)
 
 
 def insert_dead_declaration(source: str, method_name_word: str,
@@ -158,7 +224,8 @@ class SourceAttack:
                  max_iters: int = 4):
         self.config = config
         self.model = model
-        self.extractor = Extractor(config)
+        self.extractor = Extractor(config)  # re-created per attack_file
+        #                                     to match the source language
         self.attack = GradientRenameAttack(
             model.dims, model.vocabs.token_vocab,
             model.vocabs.target_vocab,
@@ -193,6 +260,14 @@ class SourceAttack:
                     target_name: Optional[str] = None,
                     max_renames: int = 1,
                     deadcode: bool = False) -> SourceAttackResult:
+        language = "python" if path.endswith(".py") else "java"
+        if self.extractor.language != language:
+            self.extractor = Extractor(self.config, language=language)
+        if deadcode and language == "python":
+            raise ValueError(
+                "--attack_deadcode supports Java sources only (the "
+                "python insertion heuristic is not implemented); use "
+                "the rename attack for .py inputs")
         with open(path, encoding="utf-8") as f:
             source = f.read()
         names, lines = self.extractor.extract_paths(path)
@@ -280,8 +355,9 @@ class SourceAttack:
         else:
             # rename mode: only tokens that map to a DECLARED variable
             # in this source are legitimate rename targets
-            declared = {normalize_identifier(d)
-                        for d in declared_variables(source)}
+            declared = {normalize_identifier(d) for d in
+                        declared_for(source,
+                                     self.extractor.language)}
             token_ids = [t for t, _ in self.attack.attackable_tokens(
                 method[0], method[2], method[3])
                 if self.attack.token_vocab.lookup_word(t) in declared]
@@ -302,10 +378,14 @@ class SourceAttack:
                     normalize_identifier(token_ids_from) == orig_tok:
                 idents = [token_ids_from]
             else:
-                idents = identifiers_for_token(source, orig_tok)
+                idents = identifiers_for_token(
+                    source, orig_tok,
+                    language=self.extractor.language)
+            rename = (rename_in_source_python
+                      if self.extractor.language == "python"
+                      else rename_in_source)
             for ident in idents:
-                adv_source = rename_in_source(adv_source, ident,
-                                              new_ident)
+                adv_source = rename(adv_source, ident, new_ident)
                 renames[ident] = new_ident
 
         verified_pred = verified_ok = None

@@ -153,6 +153,54 @@ def test_declared_variables_heuristic():
     assert "helper" not in decls and "go" not in decls
 
 
+def test_declared_variables_python():
+    from code2vec_tpu.attacks.source_attack import (
+        declared_variables_python)
+    src = ("def go(loVal, name, *rest, **opts):\n"
+           "    mid = loVal + 1\n"
+           "    for i in range(mid):\n"
+           "        helper(mid)\n"
+           "    return mid\n")
+    decls = declared_variables_python(src)
+    assert set(decls) == {"loVal", "name", "rest", "opts", "mid", "i"}
+    assert "helper" not in decls and "range" not in decls
+    assert declared_variables_python("def broken(:") == []
+
+
+def test_source_level_python_rename_attack(trained, tmp_path):
+    cfg, model, _ = trained
+    py = tmp_path / "victim.py"
+    py.write_text(
+        "def foo(value, count):\n"
+        "    index = value + count\n"
+        "    return index * value\n")
+    attack = SourceAttack(cfg, model, max_iters=3)
+    res = attack.attack_file(str(py), targeted=False, max_renames=2)
+    assert res.attack.original_prediction
+    if res.renames:
+        for old, new in res.renames.items():
+            # word-boundary: the new name may CONTAIN the old one
+            import re as _re
+            assert _re.search(rf"\b{old}\b",
+                              res.adversarial_source) is None
+            assert new in res.adversarial_source
+    # dead-code mode is a documented Java-only feature
+    with pytest.raises(ValueError, match="Java"):
+        attack.attack_file(str(py), targeted=False, deadcode=True)
+
+
+def test_python_rename_preserves_kwarg_names():
+    from code2vec_tpu.attacks.source_attack import (
+        rename_in_source_python)
+    src = ("def go(timeout):\n"
+           "    return fetch(url, timeout=timeout, s='timeout')\n")
+    out = rename_in_source_python(src, "timeout", "qux")
+    # param + value renamed; the callee's kwarg NAME and the string stay
+    assert "def go(qux):" in out
+    assert "timeout=qux" in out
+    assert "'timeout'" in out
+
+
 def test_dead_declaration_skips_call_sites():
     # `if (check()) {` is a call followed by a block, not a declaration
     src = ("class A { void run() { if (check()) { doIt(); } } "
