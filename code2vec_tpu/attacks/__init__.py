@@ -9,6 +9,9 @@ Code", Yefet, Alon & Yahav 2020).
   real Java or Python source) verified end-to-end via re-extraction.
 - robustness: untargeted attack sweep over a test split -> robustness
   metrics (module CLI).
+- defense: randomized rename augmentation (--adv_rename_prob).
+- vm_attack: the same attack against the VarMisuse head (the paper's
+  second target model).
 """
 
 from code2vec_tpu.attacks.gradient_attack import (AttackResult,
@@ -18,7 +21,10 @@ from code2vec_tpu.attacks.gradient_attack import (AttackResult,
 from code2vec_tpu.attacks.robustness import evaluate_robustness
 from code2vec_tpu.attacks.source_attack import (SourceAttack,
                                                 SourceAttackResult)
+from code2vec_tpu.attacks.vm_attack import (VMAttackResult,
+                                            VMGradientRenameAttack)
 
 __all__ = ["AttackResult", "GradientRenameAttack", "candidate_mask",
            "render_identifier", "SourceAttack", "SourceAttackResult",
-           "evaluate_robustness"]
+           "evaluate_robustness", "VMAttackResult",
+           "VMGradientRenameAttack"]

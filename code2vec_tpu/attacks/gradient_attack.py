@@ -47,21 +47,26 @@ from code2vec_tpu.models.encoder import (ModelDims, full_logits,
 from code2vec_tpu.vocab.vocabularies import Vocab
 
 _LETTERS_RE = re.compile(r"^[a-z]+$")
-# Reserved words are not identifiers: a rename to `while` would emit
-# invalid source. Java's set (+ `var`/`String`, which would shadow)
-# united with Python's (both frontends share the candidate pool;
-# keywords are all lowercase single words, so only single-subtoken
-# tokens can collide — camelCase renders never do).
+# Java's reserved words (+ `var`/`string`, which would shadow). Used to
+# filter Java DECLARATIONS — words like `match`/`value` are legal Java
+# identifiers and must stay attackable, so Python's keywords are NOT in
+# this set.
 JAVA_KEYWORDS = frozenset(
     "abstract assert boolean break byte case catch char class const "
     "continue default do double else enum extends final finally float "
     "for goto if implements import instanceof int interface long native "
     "new package private protected public return short static strictfp "
     "super switch synchronized this throw throws transient try void "
-    "volatile while true false null var string "
-    # Python reserved / soft-reserved words
-    "and as async await def del elif except from global import in is "
-    "lambda nonlocal not or pass raise with yield none match self".split())
+    "volatile while true false null var string".split())
+PYTHON_KEYWORDS = frozenset(
+    "and as assert async await break class continue def del elif else "
+    "except finally for from global if import in is lambda nonlocal "
+    "not or pass raise return try while with yield none true false "
+    "match self".split())
+# The NEW-name candidate pool is shared by both frontends, so a
+# replacement must be a valid identifier in either language. Keywords
+# are lowercase single words — camelCase renders never collide.
+RESERVED_WORDS = JAVA_KEYWORDS | PYTHON_KEYWORDS
 
 
 def render_identifier(token_word: str) -> Optional[str]:
@@ -76,9 +81,54 @@ def render_identifier(token_word: str) -> Optional[str]:
     if not subs or any(not _LETTERS_RE.match(s) for s in subs):
         return None
     ident = subs[0] + "".join(s.capitalize() for s in subs[1:])
-    if ident.lower() in JAVA_KEYWORDS:
+    if ident.lower() in RESERVED_WORDS:
         return None
     return ident
+
+
+def spare_row(padded_rows: int, *arrays: np.ndarray) -> int:
+    """A vocab row not used by any of `arrays` (the occurrence-isolation
+    remap target for the gradient trick)."""
+    used = set(np.concatenate([np.asarray(a).ravel()
+                               for a in arrays]).tolist())
+    for cand in range(padded_rows - 1, -1, -1):
+        if cand not in used:
+            return cand
+    raise ValueError("no spare vocab row (vocab smaller than the ids?)")
+
+
+def attack_succeeded(targeted: bool, pred: int, label: int,
+                     original: int) -> bool:
+    """Shared success predicate: targeted hits the label; untargeted
+    departs from the clean prediction."""
+    return pred == label if targeted else pred != original
+
+
+def build_shortlist(scores: np.ndarray, legal: np.ndarray, tried: set,
+                    top_k: int, cur_id: int) -> np.ndarray:
+    """First-order scores -> [top_k] candidate ids. Illegal and
+    already-tried rows are inf-masked before the argsort; the LAST slot
+    re-evaluates the current id so the caller's acceptance test costs
+    no extra jit call. Masked rows can still leak into a short argsort
+    (vocab barely above top_k) — guard_leaked handles them after exact
+    evaluation."""
+    scores[~legal] = np.inf
+    for t in tried:
+        scores[t] = np.inf
+    cand = np.empty((top_k,), np.int32)
+    cand[:-1] = np.argsort(scores)[:top_k - 1]
+    cand[-1] = cur_id
+    return cand
+
+
+def guard_leaked(att_losses: np.ndarray, scores: np.ndarray,
+                 shortlist: np.ndarray) -> np.ndarray:
+    """Never accept a shortlist row whose first-order score was
+    inf-masked (illegal/tried rows that leaked through a short
+    argsort)."""
+    att_losses[:-1] = np.where(np.isinf(scores[shortlist[:-1]]),
+                               np.inf, att_losses[:-1])
+    return att_losses
 
 
 def candidate_mask(token_vocab: Vocab, padded_rows: int) -> np.ndarray:
@@ -255,14 +305,6 @@ class GradientRenameAttack:
         out.sort(key=lambda ic: -ic[1])
         return out
 
-    def _spare_row(self, src: np.ndarray, dst: np.ndarray) -> int:
-        """A vocab row not used by this method (occurrence isolation)."""
-        used = set(np.concatenate([src, dst]).tolist())
-        for cand in range(self.dims.padded(self.dims.token_vocab_size)
-                          - 1, -1, -1):
-            if cand not in used:
-                return cand
-        raise ValueError("no spare vocab row (vocab smaller than 2C?)")
 
     # -- single-variable attack -----------------------------------------
     def attack_token(self, params, method: Tuple[np.ndarray, np.ndarray,
@@ -285,7 +327,8 @@ class GradientRenameAttack:
         occ_src = src == token_id
         occ_dst = dst == token_id
         occ = (jnp.asarray(occ_src), jnp.asarray(occ_dst))
-        spare = self._spare_row(src, dst)
+        spare = spare_row(self.dims.padded(self.dims.token_vocab_size),
+                          src, dst)
         sign = 1.0 if targeted else -1.0
         cur_id = token_id
         steps: List[RenameStep] = []
@@ -299,28 +342,19 @@ class GradientRenameAttack:
             scores = np.array(self.score_fn(
                 params, ids, occ, jnp.int32(spare), jnp.int32(label),
                 sign))
-            scores[~self.legal] = np.inf
-            for t in tried:
-                scores[t] = np.inf
-            # shortlist K-1 candidates; the last slot re-evaluates the
-            # CURRENT id so the acceptance test costs no extra jit call
-            cand = np.empty((self.top_k,), np.int32)
-            cand[:-1] = np.argsort(scores)[:self.top_k - 1]
-            cand[-1] = cur_id
+            cand = build_shortlist(scores, self.legal, tried,
+                                   self.top_k, cur_id)
             loss_k, top1_k, _ = self.eval_fn(
                 params, ids, occ, jnp.asarray(cand), jnp.int32(label))
-            att_loss_k = sign * np.asarray(loss_k)
+            att_loss_k = guard_leaked(sign * np.asarray(loss_k),
+                                      scores, cand)
             top1_k = np.asarray(top1_k)
-            # masked-out rows may leak into a short argsort shortlist
-            # (vocab barely above K): never accept them
-            att_loss_k[:-1] = np.where(np.isinf(scores[cand[:-1]]),
-                                       np.inf, att_loss_k[:-1])
             cur_attack_loss = float(att_loss_k[-1])
             best = int(np.argmin(att_loss_k[:-1]))
             tried.update(int(c) for c in cand)
             if att_loss_k[best] >= cur_attack_loss:
-                return (self._succeeded(targeted, int(top1_k[-1]),
-                                        label, original_top1),
+                return (attack_succeeded(targeted, int(top1_k[-1]),
+                                         label, original_top1),
                         cur_id, steps, it)
             new_id = int(cand[best])
             steps.append(RenameStep(
@@ -331,15 +365,10 @@ class GradientRenameAttack:
             cur_src = np.where(occ_src, new_id, cur_src)
             cur_dst = np.where(occ_dst, new_id, cur_dst)
             cur_id = new_id
-            if self._succeeded(targeted, int(top1_k[best]), label,
-                               original_top1):
+            if attack_succeeded(targeted, int(top1_k[best]), label,
+                                original_top1):
                 return True, cur_id, steps, it
         return False, cur_id, steps, self.max_iters
-
-    @staticmethod
-    def _succeeded(targeted: bool, top1: int, label: int,
-                   original_top1: int) -> bool:
-        return top1 == label if targeted else top1 != original_top1
 
     # -- whole-method attack --------------------------------------------
     def attack_method(self, params, method, *, targeted: bool = False,

@@ -96,10 +96,22 @@ def declared_variables_python(source: str) -> List[str]:
         tree = ast.parse(source)
     except SyntaxError:
         return []
+    # names bound by constructs whose binder the renamer cannot rewrite
+    # as a positioned node (`except E as x`, `import m as x`) are
+    # excluded — renaming their uses but not the binder would break the
+    # program. global/nonlocal names stay eligible: the renamer
+    # rewrites those statements too.
+    hazards = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            hazards.add(node.name)
+        elif isinstance(node, ast.alias):
+            hazards.add(node.asname or node.name)
     out, seen = [], set()
 
     def add(name: str) -> None:
-        if name not in seen and not name.startswith("__"):
+        if (name not in seen and name not in hazards
+                and not name.startswith("__")):
             seen.add(name)
             out.append(name)
 
@@ -154,12 +166,25 @@ def rename_in_source_python(source: str, old_ident: str,
         tree = ast.parse(source)
     except SyntaxError:
         return rename_in_source(source, old_ident, new_ident)
+    lines = source.splitlines(keepends=True)
     spots = []
     for node in ast.walk(tree):
         if (isinstance(node, ast.Name) and node.id == old_ident) or \
                 (isinstance(node, ast.arg) and node.arg == old_ident):
             spots.append((node.lineno, node.col_offset))
-    lines = source.splitlines(keepends=True)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)) \
+                and old_ident in node.names:
+            # names here are bare strings without node positions; the
+            # statement span contains only keywords/names/commas, so a
+            # word-boundary scan inside it locates them exactly
+            for ln in range(node.lineno, node.end_lineno + 1):
+                text = lines[ln - 1]
+                lo = node.col_offset if ln == node.lineno else 0
+                hi = (node.end_col_offset if ln == node.end_lineno
+                      else len(text))
+                for m in re.finditer(
+                        rf"\b{re.escape(old_ident)}\b", text[lo:hi]):
+                    spots.append((ln, lo + m.start()))
     for ln, col in sorted(spots, reverse=True):
         line = lines[ln - 1]
         if line[col:col + len(old_ident)] == old_ident:

@@ -1,0 +1,249 @@
+"""Gradient rename attack on the VarMisuse head.
+
+Reference parity target: "Adversarial Examples for Models of Code"
+(Yefet, Alon & Yahav 2020 — the `noamyft/code2vec` fork delta,
+SURVEY.md §0 item 2) attacks BOTH of its subject models: code2vec's
+name prediction (attacks/gradient_attack.py) and a VarMisuse
+localization model — renaming one variable makes the pointer miss a
+real bug or flag correct code. This module is that attack against this
+framework's VarMisuse head (models/varmisuse.py).
+
+Tensor semantics: a VM row is (src, pth, dst, mask, cand_ids [K],
+cand_mask [K]); "renaming candidate k's variable" replaces its token id
+at every context occurrence AND at cand_ids[k] — the pointer embeds
+candidates with the same token table, so the rename moves both the
+syntactic environment and the candidate's own embedding. The search is
+the same TPU-first recipe as the code2vec attack: one backward pass for
+the loss gradient at a shared occurrence embedding (spare-row remap,
+exact for this head), one [V,E] @ [E] matvec scoring every vocab token,
+exact re-scoring of the top-K shortlist in one batched forward.
+Success: the predicted candidate SLOT differs from the clean prediction
+(untargeted) or equals an attacker-chosen slot (targeted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.attacks.gradient_attack import (attack_succeeded,
+                                                  build_shortlist,
+                                                  candidate_mask,
+                                                  guard_leaked,
+                                                  spare_row)
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.models.varmisuse import vm_scores
+from code2vec_tpu.vocab.vocabularies import Vocab
+
+
+@dataclasses.dataclass
+class VMAttackResult:
+    success: bool
+    targeted: bool
+    original_slot: int            # clean predicted candidate slot
+    final_slot: int
+    target_slot: Optional[int]
+    renames: List[Tuple[str, str]]  # per-variable (orig, final) tokens
+    iterations: int
+
+    def __str__(self) -> str:
+        kind = "targeted" if self.targeted else "untargeted"
+        status = "SUCCESS" if self.success else "failed"
+        rename = (", ".join(f"{a} -> {b}" for a, b in self.renames)
+                  if self.renames else "(no rename)")
+        line = (f"[vm {kind} {status}] rename {rename}: predicted slot "
+                f"{self.original_slot} -> {self.final_slot}")
+        if self.targeted:
+            line += f" (target slot {self.target_slot})"
+        return line
+
+
+def make_vm_attack_steps(dims: ModelDims, *, compute_dtype=jnp.float32):
+    """(score_fn, eval_fn, predict_fn) for one VM row.
+
+    `ids` = (src [C], pth [C], dst [C], mask [C], cand [K], cmask [K]);
+    `occ` = (occ_src [C], occ_dst [C], occ_cand [K]) bool slots of the
+    attacked variable; `label` is a candidate SLOT index."""
+
+    def _slot_ce(params, src, pth, dst, mask, cand, cmask, label):
+        scores, _ = vm_scores(params, src[None], pth[None], dst[None],
+                              mask[None], cand[None], cmask[None],
+                              compute_dtype=compute_dtype)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        return -logp[0, label]
+
+    @jax.jit
+    def score_fn(params, ids, occ, spare, label, sign):
+        src, pth, dst, mask, cand, cmask = ids
+        occ_src, occ_dst, occ_cand = occ
+        table = params["token_emb"]
+        src2 = jnp.where(occ_src, spare, src)
+        dst2 = jnp.where(occ_dst, spare, dst)
+        cand2 = jnp.where(occ_cand, spare, cand)
+        cur_id = jnp.max(jnp.where(occ_cand, cand, -1))
+        e_var = table[cur_id].astype(jnp.float32)
+
+        def loss_of(e):
+            t2 = table.at[spare].set(e.astype(table.dtype))
+            p2 = dict(params, token_emb=t2)
+            return sign * _slot_ce(p2, src2, pth, dst2, mask, cand2,
+                                   cmask, label)
+
+        g = jax.grad(loss_of)(e_var)
+        return (table.astype(jnp.float32) @ g) - (e_var @ g)
+
+    @jax.jit
+    def eval_fn(params, ids, occ, cand_tok, label):
+        src, pth, dst, mask, cand, cmask = ids
+        occ_src, occ_dst, occ_cand = occ
+        Kc = cand_tok.shape[0]
+        srcK = jnp.where(occ_src[None, :], cand_tok[:, None],
+                         src[None, :])
+        dstK = jnp.where(occ_dst[None, :], cand_tok[:, None],
+                         dst[None, :])
+        candK = jnp.where(occ_cand[None, :], cand_tok[:, None],
+                          cand[None, :])
+        pthK = jnp.broadcast_to(pth[None, :], (Kc, pth.shape[0]))
+        maskK = jnp.broadcast_to(mask[None, :], (Kc, mask.shape[0]))
+        cmaskK = jnp.broadcast_to(cmask[None, :], (Kc, cmask.shape[0]))
+        scores, _ = vm_scores(params, srcK, pthK, dstK, maskK, candK,
+                              cmaskK, compute_dtype=compute_dtype)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        labels = jnp.full((Kc,), label, dtype=jnp.int32)
+        ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        pred = jnp.argmax(scores, axis=-1)
+        return ce, pred
+
+    @jax.jit
+    def predict_fn(params, ids):
+        src, pth, dst, mask, cand, cmask = ids
+        scores, _ = vm_scores(params, src[None], pth[None], dst[None],
+                              mask[None], cand[None], cmask[None],
+                              compute_dtype=compute_dtype)
+        return jnp.argmax(scores[0])
+
+    return score_fn, eval_fn, predict_fn
+
+
+class VMGradientRenameAttack:
+    """Host loop — the code2vec attack's structure over VM rows: greedy
+    over candidate variables, iterative gradient-shortlist + exact
+    re-score per variable."""
+
+    def __init__(self, dims: ModelDims, token_vocab: Vocab, *,
+                 top_k_candidates: int = 32, max_iters: int = 4,
+                 compute_dtype=jnp.float32):
+        self.dims = dims
+        self.token_vocab = token_vocab
+        self.top_k = min(top_k_candidates,
+                         dims.padded(dims.token_vocab_size))
+        self.max_iters = max_iters
+        self.score_fn, self.eval_fn, self.predict_fn = \
+            make_vm_attack_steps(dims, compute_dtype=compute_dtype)
+        self.legal = candidate_mask(token_vocab,
+                                    dims.padded(dims.token_vocab_size))
+
+    def attack_method(self, params, row, *, targeted: bool = False,
+                      target_slot: Optional[int] = None,
+                      max_renames: int = 1,
+                      forbidden: frozenset = frozenset()
+                      ) -> VMAttackResult:
+        """`row` = (src, pth, dst, mask, cand_ids, cand_mask) for ONE
+        VM example (numpy). Greedily renames up to `max_renames`
+        candidate variables (most context occurrences first);
+        `forbidden` token ids are never chosen as new names."""
+        src, pth, dst, mask, cand, cmask = (np.asarray(a) for a in row)
+        ids0 = tuple(jnp.asarray(a)
+                     for a in (src, pth, dst, mask, cand, cmask))
+        original = int(self.predict_fn(params, ids0))
+        if targeted:
+            if target_slot is None:
+                raise ValueError("targeted VM attack needs a slot")
+            label, sign = int(target_slot), 1.0
+        else:
+            label, sign = original, -1.0
+
+        # attackable = valid candidate slots whose token is a legal
+        # identifier, ordered by context-occurrence count
+        slots = []
+        for k in range(cand.shape[0]):
+            t = int(cand[k])
+            if cmask[k] > 0 and t < len(self.legal) and self.legal[t]:
+                occ = int((src == t).sum() + (dst == t).sum())
+                slots.append((occ, k))
+        slots.sort(reverse=True)
+
+        cur = (src.copy(), pth, dst.copy(), mask, cand.copy(), cmask)
+        renames: List[Tuple[int, int]] = []
+        iters = 0
+        success = False
+        for _, k in slots[:max_renames]:
+            ok, final_id, changed, used = self._attack_slot(
+                params, cur, k, label, sign, targeted, original,
+                forbidden)
+            iters += used
+            if changed:
+                renames.append((int(cand[k]), final_id))
+            if ok:
+                success = True
+                break
+
+        idsF = tuple(jnp.asarray(a) for a in cur)
+        final = int(self.predict_fn(params, idsF))
+        look = self.token_vocab.lookup_word
+        return VMAttackResult(
+            success=success, targeted=targeted, original_slot=original,
+            final_slot=final, target_slot=target_slot,
+            renames=[(look(a), look(b)) for a, b in renames],
+            iterations=iters)
+
+    def _attack_slot(self, params, cur, k: int, label: int, sign: float,
+                     targeted: bool, original: int,
+                     forbidden: frozenset
+                     ) -> Tuple[bool, int, bool, int]:
+        """Iteratively rename candidate slot k's variable IN PLACE in
+        `cur`. Returns (success, final_token_id, changed, iters)."""
+        src, pth, dst, mask, cand, cmask = cur
+        token_id = int(cand[k])
+        occ_src, occ_dst = src == token_id, dst == token_id
+        occ_cand = cand == token_id
+        occ = tuple(jnp.asarray(a) for a in (occ_src, occ_dst, occ_cand))
+        spare = spare_row(self.dims.padded(self.dims.token_vocab_size),
+                          src, dst, cand)
+        tried = ({token_id} | set(forbidden)
+                 | set(np.unique(np.concatenate(
+                     [src.ravel(), dst.ravel(), cand.ravel()])).tolist()))
+        cur_id = token_id
+        changed = False
+        for it in range(1, self.max_iters + 1):
+            ids = tuple(jnp.asarray(a)
+                        for a in (src, pth, dst, mask, cand, cmask))
+            scores = np.array(self.score_fn(
+                params, ids, occ, jnp.int32(spare), jnp.int32(label),
+                sign))
+            shortlist = build_shortlist(scores, self.legal, tried,
+                                        self.top_k, cur_id)
+            ce, pred = self.eval_fn(params, ids, occ,
+                                    jnp.asarray(shortlist),
+                                    jnp.int32(label))
+            att = guard_leaked(sign * np.asarray(ce), scores, shortlist)
+            pred = np.asarray(pred)
+            best = int(np.argmin(att[:-1]))
+            tried.update(int(c) for c in shortlist)
+            if att[best] >= float(att[-1]):
+                return (attack_succeeded(targeted, int(pred[-1]), label,
+                                         original), cur_id, changed, it)
+            new_id = int(shortlist[best])
+            for arr, o in ((src, occ_src), (dst, occ_dst),
+                           (cand, occ_cand)):
+                arr[o] = new_id
+            cur_id = new_id
+            changed = True
+            if attack_succeeded(targeted, int(pred[best]), label,
+                                original):
+                return True, cur_id, True, it
+        return False, cur_id, changed, self.max_iters

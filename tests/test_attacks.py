@@ -189,6 +189,41 @@ def test_source_level_python_rename_attack(trained, tmp_path):
         attack.attack_file(str(py), targeted=False, deadcode=True)
 
 
+def test_python_rename_rewrites_global_statements():
+    from code2vec_tpu.attacks.source_attack import (
+        rename_in_source_python)
+    src = ("cnt = 0\n"
+           "def f():\n"
+           "    global cnt\n"
+           "    cnt = cnt + 1\n")
+    out = rename_in_source_python(src, "cnt", "qux")
+    assert "global qux" in out and "cnt" not in out
+
+
+def test_python_declared_excludes_unrenameable_binders():
+    from code2vec_tpu.attacks.source_attack import (
+        declared_variables_python)
+    src = ("import os as osmod\n"
+           "def f(x):\n"
+           "    try:\n"
+           "        y = x\n"
+           "    except ValueError as err:\n"
+           "        return err\n"
+           "    return y\n")
+    decls = declared_variables_python(src)
+    assert "err" not in decls and "osmod" not in decls
+    assert {"x", "y"} <= set(decls)
+
+
+def test_java_declared_keeps_python_keyword_words():
+    # `match`/`value` are legal Java identifiers; the Python keyword
+    # set must not leak into the Java declaration filter
+    from code2vec_tpu.attacks.source_attack import declared_variables
+    src = "int go(int match) { int value = match; return value; }"
+    decls = declared_variables(src)
+    assert {"match", "value"} <= set(decls)
+
+
 def test_python_rename_preserves_kwarg_names():
     from code2vec_tpu.attacks.source_attack import (
         rename_in_source_python)

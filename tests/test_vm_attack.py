@@ -1,0 +1,92 @@
+"""VarMisuse-head adversarial attack tests (attacks/vm_attack.py): the
+paper's second target model — renaming a candidate variable must be
+able to move the pointer's localization."""
+
+import os
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.attacks.vm_attack import VMGradientRenameAttack
+from code2vec_tpu.data.varmisuse_gen import write_vm_dataset
+from code2vec_tpu.data.vm_reader import parse_vm_rows
+from code2vec_tpu.extractor import native
+from tests.test_varmisuse import vm_config
+
+
+@pytest.fixture(scope="module")
+def vm_trained(tmp_path_factory):
+    if not native.available():
+        pytest.skip("native extractor not built")
+    from code2vec_tpu.models.vm_model import VarMisuseModel
+    d = tmp_path_factory.mktemp("vm_attack")
+    prefix = os.path.join(str(d), "vm")
+    write_vm_dataset(prefix, n_train=1200, n_val=150, n_test=100,
+                     seed=11)
+    cfg = vm_config(prefix)
+    cfg.test_data_path = prefix + ".val.vm.c2v"
+    model = VarMisuseModel(cfg)
+    model.train()
+    return cfg, model, prefix
+
+
+def _rows(cfg, model, prefix, n):
+    with open(prefix + ".val.vm.c2v", encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()][:n]
+    labels, src, pth, dst, mask, cand, cmask, valid, _ = parse_vm_rows(
+        lines, model.vocabs, cfg.MAX_CONTEXTS, cfg.MAX_CANDIDATES)
+    keep = [i for i in range(len(lines)) if valid[i] > 0]
+    return [(src[i], pth[i], dst[i], mask[i], cand[i], cmask[i])
+            for i in keep], [int(labels[i]) for i in keep]
+
+
+def test_vm_untargeted_attack_moves_the_pointer(vm_trained):
+    cfg, model, prefix = vm_trained
+    attack = VMGradientRenameAttack(
+        model.dims, model.vocabs.token_vocab, max_iters=4,
+        compute_dtype=model.compute_dtype)
+    rows, _ = _rows(cfg, model, prefix, 12)
+    results = [attack.attack_method(model.params, r, targeted=False,
+                                    max_renames=2) for r in rows]
+    flips = sum(r.success for r in results)
+    assert flips >= len(results) // 3, \
+        f"only {flips}/{len(results)} VM attacks moved the pointer"
+    for r in results:
+        if r.success:
+            assert r.final_slot != r.original_slot
+        assert r.iterations >= 1
+
+
+def test_vm_targeted_attack_points_at_chosen_slot(vm_trained):
+    cfg, model, prefix = vm_trained
+    attack = VMGradientRenameAttack(
+        model.dims, model.vocabs.token_vocab, max_iters=5,
+        top_k_candidates=48, compute_dtype=model.compute_dtype)
+    rows, _ = _rows(cfg, model, prefix, 12)
+    hits = tried = 0
+    for r in rows:
+        cmask = np.asarray(r[5])
+        clean = attack.attack_method(model.params, r, targeted=False,
+                                     max_renames=0)
+        # aim at a DIFFERENT live slot than the clean prediction
+        live = [k for k in range(len(cmask)) if cmask[k] > 0
+                and k != clean.original_slot]
+        if not live:
+            continue
+        tried += 1
+        res = attack.attack_method(model.params, r, targeted=True,
+                                   target_slot=live[0], max_renames=2)
+        if res.success:
+            hits += 1
+            assert res.final_slot == live[0]
+    assert tried >= 8
+    assert hits >= 1, "targeted VM attack never reached its slot"
+
+
+def test_vm_attack_requires_slot_for_targeted(vm_trained):
+    _, model, prefix = vm_trained
+    attack = VMGradientRenameAttack(model.dims,
+                                    model.vocabs.token_vocab)
+    rows, _ = _rows(vm_trained[0], model, prefix, 1)
+    with pytest.raises(ValueError, match="slot"):
+        attack.attack_method(model.params, rows[0], targeted=True)
