@@ -106,7 +106,13 @@ def declared_variables_python(source: str) -> List[str]:
         if isinstance(node, ast.ExceptHandler) and node.name:
             hazards.add(node.name)
         elif isinstance(node, ast.alias):
-            hazards.add(node.asname or node.name)
+            # `import os.path` binds the FIRST segment (`os`)
+            hazards.add((node.asname or node.name).split(".")[0])
+        elif isinstance(node, (ast.MatchAs, ast.MatchStar)) \
+                and node.name:
+            hazards.add(node.name)  # match-pattern capture binders
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            hazards.add(node.rest)
     out, seen = [], set()
 
     def add(name: str) -> None:

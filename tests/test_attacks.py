@@ -213,6 +213,18 @@ def test_python_declared_excludes_unrenameable_binders():
     decls = declared_variables_python(src)
     assert "err" not in decls and "osmod" not in decls
     assert {"x", "y"} <= set(decls)
+    # match-capture binders and dotted-import roots are bare strings in
+    # the AST (no positions) -> never rename targets
+    src2 = ("import os.path\n"
+            "def g(v):\n"
+            "    x = 0\n"
+            "    match v:\n"
+            "        case x:\n"
+            "            return x\n"
+            "    return x + len(os.path.sep)\n")
+    decls2 = declared_variables_python(src2)
+    assert "x" not in decls2 and "os" not in decls2
+    assert "v" in decls2
 
 
 def test_java_declared_keeps_python_keyword_words():

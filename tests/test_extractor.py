@@ -122,6 +122,42 @@ def test_malformed_input_never_crashes(tmp_path):
 
 
 @needs_binary
+def test_fuzz_mutated_and_random_sources(tmp_path):
+    """Seeded fuzz: byte-level mutations of a real source plus random
+    token soup must never crash the parser (fuel-bounded recursive
+    descent; ASan build available via C2V_SANITIZE). One threaded --dir
+    run over all cases keeps this fast."""
+    import random
+    rng = random.Random(0xC2)
+    with open(os.path.join(GOLDEN_DIR, "Example.java")) as f:
+        base = f.read()
+    d = tmp_path / "fuzz"
+    d.mkdir()
+    for i in range(60):  # mutations: delete / insert / splice
+        s = list(base)
+        for _ in range(rng.randint(1, 8)):
+            op = rng.randrange(3)
+            pos = rng.randrange(max(len(s), 1))
+            if op == 0 and s:
+                del s[pos]
+            elif op == 1:
+                s.insert(pos, rng.choice("{}();,.<>[]@\"'\\\x00\xff"))
+            else:
+                s.insert(pos, rng.choice(["class", "((", "}}", "/*",
+                                          "*/", "//", "\"", "for(",
+                                          "int", "...."]))
+        (d / f"Mut{i}.java").write_text("".join(s), errors="replace")
+    soup = ("class interface enum void int if for while return new "
+            "{ } ( ) ; , . < > [ ] = + - ! @ # $ % \" ' \\ 0x1p3 "
+            "é 中").split(" ")
+    for i in range(40):
+        (d / f"Soup{i}.java").write_text(
+            " ".join(rng.choice(soup)
+                     for _ in range(rng.randint(0, 400))))
+    run_extractor("--dir", str(d), "--num_threads", "4")  # rc == 0
+
+
+@needs_binary
 def test_dir_mode_and_threads(tmp_path):
     for i in range(8):
         (tmp_path / f"F{i}.java").write_text(

@@ -94,3 +94,32 @@ def test_ring_matches_dense_bf16_compute():
     np.testing.assert_allclose(
         np.asarray(out_ring, np.float32), np.asarray(out_ref, np.float32),
         atol=2e-2)
+
+
+def test_ring_compiled_memory_is_o_c_over_s():
+    """The O(C/s) memory claim (tools/ring_memory.py, BASELINE.md
+    long-context row): per-device temp memory of the compiled ring
+    program must be several times below the all-gather path's at a
+    context length where the attention matrix dominates."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from code2vec_tpu.parallel.mesh import CONTEXT_AXIS
+
+    B, H, C, hd = 2, 2, 2048, 16
+    mesh = make_mesh(1, 1, 4)
+    shard = NamedSharding(mesh, P(None, None, CONTEXT_AXIS, None))
+    mshard = NamedSharding(mesh, P(None, CONTEXT_AXIS))
+    q, k, v, _ = _inputs(B, H, C, hd)
+    args = (jax.device_put(q, shard), jax.device_put(k, shard),
+            jax.device_put(v, shard),
+            jax.device_put(jnp.zeros((B, C), jnp.float32), mshard))
+    shardings = (shard, shard, shard, mshard)
+    ring = jax.jit(lambda q, k, v, m: ring_attention(q, k, v, m, mesh),
+                   in_shardings=shardings, out_shardings=shard
+                   ).lower(*args).compile()
+    dense = jax.jit(dense_oracle, in_shardings=shardings,
+                    out_shardings=shard).lower(*args).compile()
+    r = ring.memory_analysis().temp_size_in_bytes
+    d = dense.memory_analysis().temp_size_in_bytes
+    # 4 ctx shards -> expect ~4x; accept >2x to stay robust across
+    # XLA versions' fusion choices
+    assert d / max(r, 1) > 2.0, (r, d)
