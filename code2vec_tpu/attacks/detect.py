@@ -1,0 +1,98 @@
+"""Adversarial-input detection via attention-weighted token rarity.
+
+Reference parity target: the detection defense of "Adversarial Examples
+for Models of Code" (Yefet, Alon & Yahav 2020 — the `noamyft/code2vec`
+fork delta, SURVEY.md §0 item 2): adversarially-chosen names are
+*outliers* — the gradient search draws them from the whole vocabulary,
+so they are overwhelmingly rare in training data, while the attack
+works precisely by making the model ATTEND to them. Both signals are
+already in the predict path, so detection is nearly free:
+
+    score(method) = sum_j  attn_j * rarity_j
+    rarity_j      = max(-log p(src_j), -log p(dst_j))   (add-one
+                    smoothed over the training token histogram; OOV is
+                    maximally rare)
+
+A clean method concentrates attention on common, task-bearing tokens →
+low score; an attacked one pays attention to a rare renamed token →
+high score. Calibrate the threshold on clean data at a chosen false-
+positive rate. Measured detection quality (AUC, TPR@5%FPR) comes from
+tools/robustness_study.py --detect; results in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu.models.encoder import ModelDims, get_encode_fn
+from code2vec_tpu.vocab.vocabularies import Vocab
+
+
+def load_token_counts(dict_path: str) -> Dict[str, int]:
+    """Token histogram from the dataset's `.dict.c2v` pickle (first
+    object — SURVEY.md §3.2 dict order)."""
+    with open(dict_path, "rb") as f:
+        return pickle.load(f)
+
+
+class RarityDetector:
+    @classmethod
+    def from_model(cls, model, dict_path: str) -> "RarityDetector":
+        """Build for a loaded Code2VecModel from its dataset's
+        `.dict.c2v` (the one construction every caller needs)."""
+        return cls(model.dims, model.vocabs.token_vocab,
+                   load_token_counts(dict_path),
+                   compute_dtype=model.compute_dtype)
+
+    def __init__(self, dims: ModelDims, token_vocab: Vocab,
+                 token_counts: Dict[str, int], *,
+                 compute_dtype=jnp.float32):
+        rows = dims.padded(dims.token_vocab_size)
+        total = sum(token_counts.values()) + rows  # add-one smoothing
+        rarity = np.full((rows,), -np.log(1.0 / total), np.float32)
+        for idx, word in enumerate(token_vocab.to_word_list()):
+            c = token_counts.get(word, 0)
+            rarity[idx] = -np.log((c + 1.0) / total)
+        rarity[token_vocab.pad_index] = 0.0  # masked out anyway
+        self.rarity = rarity
+        encode = get_encode_fn(dims)
+
+        @jax.jit
+        def attn_fn(params, src, pth, dst, mask):
+            _, attn = encode(params, src[None], pth[None], dst[None],
+                             mask[None], compute_dtype=compute_dtype)
+            return attn[0]
+
+        self._attn_fn = attn_fn
+
+    def score(self, params, method: Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]
+              ) -> float:
+        """Attention-weighted rarity of one tensorized method."""
+        src, pth, dst, mask = (np.asarray(a) for a in method)
+        attn = np.asarray(self._attn_fn(
+            params, jnp.asarray(src), jnp.asarray(pth),
+            jnp.asarray(dst), jnp.asarray(mask)))
+        rar = np.maximum(self.rarity[src], self.rarity[dst])
+        return float(np.sum(attn * rar * (mask > 0)))
+
+    @staticmethod
+    def calibrate(clean_scores: np.ndarray, fpr: float = 0.05) -> float:
+        """Threshold flagging the top `fpr` fraction of CLEAN scores."""
+        return float(np.quantile(np.asarray(clean_scores), 1.0 - fpr))
+
+
+def auc(clean_scores: np.ndarray, attack_scores: np.ndarray) -> float:
+    """Rank AUC (Mann-Whitney): P(attack score > clean score)."""
+    c = np.asarray(clean_scores, np.float64)
+    a = np.asarray(attack_scores, np.float64)
+    if len(c) == 0 or len(a) == 0:
+        return float("nan")
+    greater = (a[:, None] > c[None, :]).sum()
+    ties = (a[:, None] == c[None, :]).sum()
+    return float((greater + 0.5 * ties) / (len(a) * len(c)))

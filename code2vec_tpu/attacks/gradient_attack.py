@@ -164,6 +164,9 @@ class AttackResult:
     renames: List[Tuple[str, str]]
     steps: List[RenameStep]       # full accepted-step trajectory
     iterations: int
+    # the post-attack tensors (src, pth, dst, mask) — what detectors
+    # and further analysis should score (None until attack_method ran)
+    final_method: Optional[tuple] = None
 
     def __str__(self) -> str:
         kind = "targeted" if self.targeted else "untargeted"
@@ -441,4 +444,4 @@ class GradientRenameAttack:
             final_prediction=tv.lookup_word(int(top1_f)),
             target_name=target_name,
             renames=[(look(a), look(b)) for a, b in renamed],
-            steps=all_steps, iterations=iters)
+            steps=all_steps, iterations=iters, final_method=cur)

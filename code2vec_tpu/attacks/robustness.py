@@ -33,9 +33,14 @@ from code2vec_tpu.data.reader import parse_c2v_rows
 def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
                         max_renames: int = 1, max_iters: int = 4,
                         top_k_candidates: int = 32,
-                        log=print) -> dict:
+                        detector=None, log=print) -> dict:
     """Attacks up to `n_methods` methods of `test_path` (untargeted,
-    greedy rename of up to `max_renames` variables) and aggregates."""
+    greedy rename of up to `max_renames` variables) and aggregates.
+
+    With a `detector` (attacks/detect.py RarityDetector), also scores
+    every clean method and every successful adversarial variant and
+    reports detection AUC + TPR at a 5% FPR threshold (threshold
+    calibrated on this sweep's own clean scores)."""
     attack = GradientRenameAttack(
         model.dims, model.vocabs.token_vocab, model.vocabs.target_vocab,
         top_k_candidates=top_k_candidates, max_iters=max_iters,
@@ -52,6 +57,7 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
 
     n = flipped = clean_correct = attacked_correct = 0
     iters_on_success, renames_on_success = [], []
+    clean_scores, attack_scores = [], []
     t0 = time.time()
     for i in range(len(lines)):
         if mask[i].sum() == 0:
@@ -62,6 +68,11 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
         res = attack.attack_method(model.params, method,
                                    targeted=False,
                                    max_renames=max_renames)
+        if detector is not None:
+            clean_scores.append(detector.score(model.params, method))
+            if res.success:
+                attack_scores.append(
+                    detector.score(model.params, res.final_method))
         n += 1
         truth = tv.lookup_word(int(labels[i])) if not tstr else tstr[i]
         clean_correct += res.original_prediction == truth
@@ -74,7 +85,7 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
             log(f"robustness: {n} methods, "
                 f"{flipped / n:.3f} attack success rate so far")
     dt = time.time() - t0
-    return {
+    report = {
         "metric": "untargeted_rename_attack_success_rate",
         "n_methods": n,
         "attack_success_rate": round(flipped / max(n, 1), 4),
@@ -92,6 +103,15 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
         "top_k_candidates": top_k_candidates,
         "seconds": round(dt, 1),
     }
+    if detector is not None and attack_scores:
+        from code2vec_tpu.attacks.detect import auc
+        thr = detector.calibrate(np.asarray(clean_scores), fpr=0.05)
+        report["detection_auc"] = round(
+            auc(np.asarray(clean_scores), np.asarray(attack_scores)), 4)
+        report["detection_tpr_at_5fpr"] = round(
+            float(np.mean(np.asarray(attack_scores) > thr)), 4)
+        report["detection_threshold"] = round(thr, 3)
+    return report
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -108,14 +128,22 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--iters", type=int, default=4)
     p.add_argument("--topk", type=int, default=32)
     p.add_argument("--out", default=None, help="also write JSON here")
+    p.add_argument("--dict", dest="dict_path", default=None,
+                   help="dataset .dict.c2v — enables rarity-outlier "
+                        "detection metrics (attacks/detect.py)")
     a = p.parse_args(argv)
 
     cfg = Config()
     cfg.load_path = a.load
     model = Code2VecModel(cfg)
+    detector = None
+    if a.dict_path:
+        from code2vec_tpu.attacks.detect import RarityDetector
+        detector = RarityDetector.from_model(model, a.dict_path)
     report = evaluate_robustness(
         model, a.test, n_methods=a.n, max_renames=a.max_renames,
-        max_iters=a.iters, top_k_candidates=a.topk, log=cfg.log)
+        max_iters=a.iters, top_k_candidates=a.topk, detector=detector,
+        log=cfg.log)
     line = json.dumps(report)
     print(line)
     if a.out:

@@ -280,6 +280,54 @@ def test_rename_never_collides_with_method_tokens(trained):
             assert s.to_token not in present
 
 
+def test_rarity_detector_flags_attacks(trained):
+    from code2vec_tpu.attacks.detect import (RarityDetector, auc,
+                                             load_token_counts)
+    _, model, prefix = trained
+    counts = load_token_counts(prefix + ".dict.c2v")
+    det = RarityDetector(model.dims, model.vocabs.token_vocab, counts,
+                         compute_dtype=model.compute_dtype)
+    report = evaluate_robustness(model, prefix + ".test.c2v",
+                                 n_methods=10, max_renames=1,
+                                 max_iters=3, detector=det,
+                                 log=lambda *_: None)
+    if "detection_auc" in report:
+        assert 0.0 <= report["detection_auc"] <= 1.0
+        assert 0.0 <= report["detection_tpr_at_5fpr"] <= 1.0
+    # AUC helper sanity: separable score sets -> 1.0; identical -> 0.5
+    assert auc(np.array([1.0, 2.0]), np.array([3.0, 4.0])) == 1.0
+    assert auc(np.array([1.0]), np.array([1.0])) == 0.5
+
+
+def test_rarity_detector_scores_rare_attention_higher(trained):
+    import jax.numpy as jnp
+    from code2vec_tpu.attacks.detect import (RarityDetector,
+                                             load_token_counts)
+    _, model, prefix = trained
+    counts = load_token_counts(prefix + ".dict.c2v")
+    det = RarityDetector(model.dims, model.vocabs.token_vocab, counts,
+                         compute_dtype=model.compute_dtype)
+    tv = model.vocabs.token_vocab
+    # two one-context methods differing only in token frequency
+    common = max(counts, key=counts.get)
+    rare = min(counts, key=counts.get)
+    C = model.dims.max_contexts
+
+    def one(tok_word):
+        t = tv.lookup_index(tok_word)
+        src = np.full((C,), tv.pad_index, np.int32)
+        src[0] = t
+        dst = src.copy()
+        pth = np.zeros((C,), np.int32)
+        mask = np.zeros((C,), np.float32)
+        mask[0] = 1.0
+        return src, pth, dst, mask
+
+    if counts[common] > counts[rare]:
+        assert det.score(model.params, one(rare)) > \
+            det.score(model.params, one(common))
+
+
 def test_rename_augment_semantics(trained):
     import jax
     import jax.numpy as jnp

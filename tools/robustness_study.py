@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def run_arm(name: str, data: str, epochs: int, batch: int,
             adv_prob: float, n_attacks: int, max_renames: int,
-            seed: int, max_contexts: int) -> dict:
+            seed: int, max_contexts: int, detect: bool = False) -> dict:
     from code2vec_tpu.attacks.robustness import evaluate_robustness
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
@@ -63,9 +63,15 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
     model.train()
     train_s = time.time() - t0
     clean = model.evaluate()
+    detector = None
+    if detect:
+        from code2vec_tpu.attacks.detect import RarityDetector
+        detector = RarityDetector.from_model(model,
+                                             data + ".dict.c2v")
     rob = evaluate_robustness(model, data + ".val.c2v",
                               n_methods=n_attacks,
-                              max_renames=max_renames, log=cfg.log)
+                              max_renames=max_renames,
+                              detector=detector, log=cfg.log)
     row = {
         "arm": name,
         "adv_rename_prob": adv_prob,
@@ -78,6 +84,9 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
         "n_attacks": rob["n_methods"],
         "train_seconds": round(train_s, 1),
     }
+    for key in ("detection_auc", "detection_tpr_at_5fpr"):
+        if key in rob:
+            row[key] = rob[key]
     print(json.dumps(row), flush=True)
     return row
 
@@ -95,6 +104,9 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--arms", default="baseline,defended",
                     help="comma list: baseline | defended")
+    ap.add_argument("--detect", action="store_true",
+                    help="also measure rarity-outlier detection "
+                         "(attacks/detect.py) on the attacked methods")
     a = ap.parse_args()
 
     arms = [s.strip() for s in a.arms.split(",")]
@@ -106,7 +118,7 @@ def main() -> int:
         prob = 0.0 if arm == "baseline" else a.adv_prob
         rows.append(run_arm(arm, a.data, a.epochs, a.batch, prob,
                             a.n_attacks, a.max_renames, a.seed,
-                            a.max_contexts))
+                            a.max_contexts, detect=a.detect))
     print(f"\n{'arm':<10} {'p':>4} {'cleanF1':>8} {'top1':>6} "
           f"{'atk-success':>11} {'atk-top1':>8}")
     for r in rows:
