@@ -88,11 +88,16 @@ class RarityDetector:
 
 
 def auc(clean_scores: np.ndarray, attack_scores: np.ndarray) -> float:
-    """Rank AUC (Mann-Whitney): P(attack score > clean score)."""
+    """Rank AUC (tie-corrected Mann-Whitney): P(attack > clean).
+    O(n log n) via average ranks — no pairwise matrix."""
     c = np.asarray(clean_scores, np.float64)
     a = np.asarray(attack_scores, np.float64)
     if len(c) == 0 or len(a) == 0:
         return float("nan")
-    greater = (a[:, None] > c[None, :]).sum()
-    ties = (a[:, None] == c[None, :]).sum()
-    return float((greater + 0.5 * ties) / (len(a) * len(c)))
+    scores = np.concatenate([c, a])
+    _, inv, cnt = np.unique(scores, return_inverse=True,
+                            return_counts=True)
+    avg_rank = np.cumsum(cnt) - (cnt - 1) / 2.0  # 1-based, tie-averaged
+    ranks = avg_rank[inv]
+    u = ranks[len(c):].sum() - len(a) * (len(a) + 1) / 2.0
+    return float(u / (len(a) * len(c)))

@@ -58,10 +58,29 @@ def cap(s: str) -> str:
     return s[:1].upper() + s[1:] if s else s
 
 
+def tail_name(rng: random.Random) -> str:
+    """A random camelCase identifier from a combinatorially large space
+    — the long-tail distractor-name universe of --tail_names mode."""
+    syll = ["tmp", "buf", "acc", "cur", "aux", "raw", "alt", "seq",
+            "loc", "ref", "arg", "ctx", "mem", "reg", "idx", "ptr",
+            "len", "pos", "src", "dst", "obj", "rec", "seg", "blk"]
+    k = rng.randint(2, 3)
+    parts = [rng.choice(syll) for _ in range(k)]
+    return parts[0] + "".join(cap(p) for p in parts[1:])
+
+
 def method_source(rng: random.Random, verb: str, adj: str,
-                  noun: str) -> str:
+                  noun: str, tail_pool=None) -> str:
     """A method whose body references identifiers correlated with the
-    name (the signal), plus random distractor statements (the noise)."""
+    name (the signal), plus random distractor statements (the noise).
+
+    With `tail_pool` (a list of long-tail junk names, --tail_names
+    mode), the body additionally declares 2-3 distractor locals drawn
+    from the tail and REPEATS the signal through a second correlated
+    local — the regime real code lives in: redundant naming cues plus
+    a rare-name tail, where single-token renames are weaker and
+    gradient-chosen replacements become frequency outliers
+    (BASELINE.md "Adversarial robustness")."""
     field = (adj + cap(noun)) if adj else noun
     mname = verb + cap(adj) + cap(noun) if adj else verb + cap(noun)
     distract = rng.choice(NOUNS)
@@ -102,6 +121,14 @@ def method_source(rng: random.Random, verb: str, adj: str,
                  f"  return {field};", "}"]
     if rng.random() < 0.3:
         lines.insert(-1, f"  int {distract} = {d2} + 1;")
+    if tail_pool:
+        # insert BEFORE a trailing return (javac-valid placement) and
+        # sample junk names WITHOUT replacement (no duplicate locals)
+        at = -2 if lines[-2].lstrip().startswith("return") else -1
+        extra = [f"  int {field}Copy = {field} + 0;"]
+        extra += [f"  int {junk} = {rng.randrange(9)};"
+                  for junk in rng.sample(tail_pool, rng.randint(2, 3))]
+        lines[at:at] = extra
     return "\n".join("  " + ln for ln in lines)
 
 
@@ -112,8 +139,28 @@ def main() -> None:
     ap.add_argument("--methods", type=int, default=250_000)
     ap.add_argument("--methods_per_class", type=int, default=50)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--tail_names", type=int, default=0,
+                    help="size of a long-tail distractor-name pool; "
+                         "0 (default) keeps the original corpus "
+                         "byte-identical")
     args = ap.parse_args()
     rng = random.Random(args.seed)
+    tail_pool = None
+    if args.tail_names:
+        tail_rng = random.Random(args.seed ^ 0x7A11)  # own stream:
+        # the default (tail_names=0) rng sequence stays untouched.
+        # dict.fromkeys: dedupe in generation order (a set's iteration
+        # order varies with hash randomization -> nondeterministic pool)
+        seen = dict.fromkeys(())
+        attempts = 0
+        while len(seen) < args.tail_names and \
+                attempts < args.tail_names * 200:
+            seen.setdefault(tail_name(tail_rng))
+            attempts += 1
+        if len(seen) < args.tail_names:
+            ap.error(f"--tail_names {args.tail_names} exceeds the "
+                     f"reachable name space (~14400; got {len(seen)})")
+        tail_pool = list(seen)
 
     # build the name universe and give it a Zipf weighting
     combos = [(v, a, n) for v in VERBS for a in ADJS for n in NOUNS]
@@ -147,7 +194,8 @@ def main() -> None:
             fields = set()
             for v, a, n in chosen:
                 fields.add((a + cap(n)) if a else n)
-                body.append(method_source(rng, v, a, n))
+                body.append(method_source(rng, v, a, n,
+                                          tail_pool=tail_pool))
             field_decls = "\n".join(f"  int {f};" for f in sorted(fields))
             cls = (f"class C{split.capitalize()}{file_idx} {{\n"
                    f"{field_decls}\n" + "\n".join(body) + "\n}\n")
