@@ -107,11 +107,11 @@ def attack_succeeded(targeted: bool, pred: int, label: int,
 def build_shortlist(scores: np.ndarray, legal: np.ndarray, tried: set,
                     top_k: int, cur_id: int) -> np.ndarray:
     """First-order scores -> [top_k] candidate ids. Illegal and
-    already-tried rows are inf-masked before the argsort; the LAST slot
+    already-tried rows are inf-masked before selection; the LAST slot
     re-evaluates the current id so the caller's acceptance test costs
-    no extra jit call. Masked rows can still leak into a short argsort
-    (vocab barely above top_k) — guard_leaked handles them after exact
-    evaluation."""
+    no extra jit call. Masked rows can still leak into a short
+    selection (vocab barely above top_k) — guard_leaked handles them
+    after exact evaluation."""
     scores[~legal] = np.inf
     for t in tried:
         scores[t] = np.inf
@@ -199,9 +199,9 @@ def make_attack_steps(dims: ModelDims, *,
         first-order loss delta of renaming the occurrence slots to each
         token row (lower = better for the attacker).
       eval_fn(params, ids, occ, cand_ids [K], label) ->
-        (loss [K], top1 [K], label_prob [K]) — exact model outputs for
-        each candidate rename.
-      predict_fn(params, ids) -> (top1, top1_prob) on the clean input.
+        (loss [K], top1 [K]) — exact model outputs for each candidate
+        rename.
+      predict_fn(params, ids) -> top1 on the clean input.
 
     `ids` is (src [C], pth [C], dst [C], mask [C]) for ONE method;
     `occ` is (occ_src [C], occ_dst [C]) bool occurrence slots;
@@ -259,9 +259,8 @@ def make_attack_steps(dims: ModelDims, *,
         labels = jnp.full((K,), label, dtype=jnp.int32)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels)
-        probs = jax.nn.softmax(logits, axis=-1)
         top1 = jnp.argmax(logits, axis=-1)
-        return loss, top1, probs[:, label]
+        return loss, top1
 
     @jax.jit
     def predict_fn(params, ids):
@@ -269,9 +268,7 @@ def make_attack_steps(dims: ModelDims, *,
         code, _ = encode(params, src[None], pth[None], dst[None],
                          mask[None], compute_dtype=compute_dtype)
         logits = full_logits(params, code, dims.target_vocab_size)
-        probs = jax.nn.softmax(logits, axis=-1)[0]
-        top1 = jnp.argmax(probs)
-        return top1, probs[top1]
+        return jnp.argmax(logits[0])
 
     return score_fn, eval_fn, predict_fn
 
@@ -315,7 +312,6 @@ class GradientRenameAttack:
         out.sort(key=lambda ic: -ic[1])
         return out
 
-
     # -- single-variable attack -----------------------------------------
     def attack_token(self, params, method: Tuple[np.ndarray, np.ndarray,
                                                  np.ndarray, np.ndarray],
@@ -354,7 +350,7 @@ class GradientRenameAttack:
                 sign))
             cand = build_shortlist(scores, self.legal, tried,
                                    self.top_k, cur_id)
-            loss_k, top1_k, _ = self.eval_fn(
+            loss_k, top1_k = self.eval_fn(
                 params, ids, occ, jnp.asarray(cand), jnp.int32(label))
             att_loss_k = guard_leaked(sign * np.asarray(loss_k),
                                       scores, cand)
@@ -401,8 +397,7 @@ class GradientRenameAttack:
         ids0 = (jnp.asarray(src), jnp.asarray(pth), jnp.asarray(dst),
                 jnp.asarray(mask))
         if baseline_top1 is None:
-            top1_0, _ = self.predict_fn(params, ids0)
-            original_top1 = int(top1_0)
+            original_top1 = int(self.predict_fn(params, ids0))
         else:
             original_top1 = int(baseline_top1)
         if targeted:
@@ -442,7 +437,7 @@ class GradientRenameAttack:
 
         idsF = (jnp.asarray(cur[0]), jnp.asarray(cur[1]),
                 jnp.asarray(cur[2]), jnp.asarray(cur[3]))
-        top1_f, _ = self.predict_fn(params, idsF)
+        top1_f = self.predict_fn(params, idsF)
         tv = self.target_vocab
         look = self.token_vocab.lookup_word
         return AttackResult(

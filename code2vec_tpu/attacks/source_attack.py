@@ -272,7 +272,7 @@ class SourceAttack:
     def _predict_word(self, method) -> str:
         import jax.numpy as jnp
         ids = tuple(jnp.asarray(a) for a in method)
-        top1, _ = self.attack.predict_fn(self.model.params, ids)
+        top1 = self.attack.predict_fn(self.model.params, ids)
         return self.model.vocabs.target_vocab.lookup_word(int(top1))
 
     def _forbidden_ids(self, source: str) -> frozenset:
@@ -317,7 +317,7 @@ class SourceAttack:
             _, pristine = self._tensorize(lines[method_index])
             import jax.numpy as jnp
             p_ids = tuple(jnp.asarray(a) for a in pristine)
-            p_top1, _ = self.attack.predict_fn(self.model.params, p_ids)
+            p_top1 = self.attack.predict_fn(self.model.params, p_ids)
             var0 = self._fresh_variable_name(source)
             mod = insert_dead_declaration(source, method_name, var0,
                                           ordinal)
