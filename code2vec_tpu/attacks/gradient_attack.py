@@ -207,6 +207,59 @@ def make_attack_steps(dims: ModelDims, *,
     `occ` is (occ_src [C], occ_dst [C]) bool occurrence slots;
     `sign` is +1.0 to minimize CE(label) (targeted) or -1.0 to maximize
     it (untargeted). K is cand_ids' static shape."""
+    raw_score, raw_eval, raw_predict = _raw_attack_steps(
+        dims, compute_dtype=compute_dtype)
+    return (jax.jit(raw_score), jax.jit(raw_eval), jax.jit(raw_predict))
+
+
+def make_batched_attack_steps(dims: ModelDims, *,
+                              compute_dtype=jnp.float32,
+                              topk_transfer: Optional[int] = None
+                              ) -> Tuple[Callable, ...]:
+    """vmapped-over-methods variants of make_attack_steps: every array
+    argument gains a leading method dim [M, ...] (params stay shared);
+    `sign` stays scalar. One dispatch attacks M methods in lockstep —
+    on the tunneled platform dispatch overhead dominates the serial
+    sweep, so batching is what makes test-set-scale sweeps fast.
+
+    Returns (eval_b, predict_b[, score_topk_b]); there is deliberately
+    NO batched raw-score function — vmapping the spare-row trick
+    materializes M functionally-updated token-table copies (64 x
+    333 MB at java-large -> OOM); the lax.map'd top-k form below is the
+    only safe batched score path:
+      score_topk_b(params, ids, occ, spare, label, sign, legal)
+        -> (scores [M, T], token_ids [M, T]), ascending
+    — the first-order scores are legality-masked and top-T-selected ON
+    DEVICE, so only [M, T] crosses the wire instead of [M, V] (166 MB
+    per iteration for a 32-method java-large chunk — the device->host
+    transfer, not dispatch, dominates once the batch is formed)."""
+    raw_score, raw_eval, raw_predict = _raw_attack_steps(
+        dims, compute_dtype=compute_dtype)
+    out = [
+        jax.jit(jax.vmap(raw_eval, in_axes=(None, 0, 0, 0, 0))),
+        jax.jit(jax.vmap(raw_predict, in_axes=(None, 0))),
+    ]
+    if topk_transfer is not None:
+        @jax.jit
+        def score_topk_b(params, ids, occ, spare, label, sign, legal):
+            def one(args):
+                ids_i, occ_i, spare_i, label_i = args
+                s = raw_score(params, ids_i, occ_i, spare_i, label_i,
+                              sign)
+                s = jnp.where(legal, s, jnp.inf)
+                neg, idx = jax.lax.top_k(-s, topk_transfer)
+                return -neg, idx
+
+            return jax.lax.map(one, (ids, occ, spare, label))
+
+        out.append(score_topk_b)
+    return tuple(out)
+
+
+def _raw_attack_steps(dims: ModelDims, *, compute_dtype=jnp.float32):
+    """The un-jitted per-method step functions (see make_attack_steps
+    for the contracts); jitted directly for the serial path and under
+    vmap for the batched path."""
     encode = get_encode_fn(dims)
 
     def _loss_from_params(params, src, pth, dst, mask, label):
@@ -216,7 +269,6 @@ def make_attack_steps(dims: ModelDims, *,
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, label[None])[0]
 
-    @jax.jit
     def score_fn(params, ids, occ, spare, label, sign):
         src, pth, dst, mask = ids
         occ_src, occ_dst = occ
@@ -244,7 +296,6 @@ def make_attack_steps(dims: ModelDims, *,
         scores = (table.astype(jnp.float32) @ g) - (e_var @ g)
         return scores
 
-    @jax.jit
     def eval_fn(params, ids, occ, cand_ids, label):
         src, pth, dst, mask = ids
         occ_src, occ_dst = occ
@@ -262,7 +313,6 @@ def make_attack_steps(dims: ModelDims, *,
         top1 = jnp.argmax(logits, axis=-1)
         return loss, top1
 
-    @jax.jit
     def predict_fn(params, ids):
         src, pth, dst, mask = ids
         code, _ = encode(params, src[None], pth[None], dst[None],
@@ -285,6 +335,7 @@ class GradientRenameAttack:
         self.dims = dims
         self.token_vocab = token_vocab
         self.target_vocab = target_vocab
+        self.compute_dtype = compute_dtype
         # the shortlist cannot exceed the vocab itself (tiny test vocabs)
         top_k_candidates = min(top_k_candidates,
                                dims.padded(dims.token_vocab_size))
@@ -292,6 +343,7 @@ class GradientRenameAttack:
         self.max_iters = max_iters
         self.score_fn, self.eval_fn, self.predict_fn = make_attack_steps(
             dims, compute_dtype=compute_dtype)
+        self._batched = None  # built lazily by attack_batch
         self.legal = candidate_mask(token_vocab,
                                     dims.padded(dims.token_vocab_size))
 
@@ -447,3 +499,132 @@ class GradientRenameAttack:
             target_name=target_name,
             renames=[(look(a), look(b)) for a, b in renamed],
             steps=all_steps, iterations=iters, final_method=cur)
+
+    # -- lockstep batch attack ------------------------------------------
+    def attack_batch(self, params, methods: Sequence[Tuple]
+                     ) -> List[AttackResult]:
+        """Untargeted single-rename attack on M methods at once —
+        semantically identical to `attack_method(m, targeted=False,
+        max_renames=1)` per method (same scores, same selections, same
+        acceptance), but each of the ~max_iters+2 jit dispatches covers
+        the WHOLE batch. On the tunneled platform, where fixed dispatch
+        cost dominates the serial sweep, this is what makes
+        test-set-scale robustness sweeps fast. Methods must each have
+        at least one attackable token (the sweep filters first)."""
+        rows = self.dims.padded(self.dims.token_vocab_size)
+        if self._batched is None:
+            # top-T transfer bound: the host drops tried ids from the
+            # device top list, so T must cover the K-1 picks plus every
+            # id that can be in `tried` (initial method tokens <= 2C+1,
+            # plus K per prior iteration)
+            T = min(rows, (self.top_k - 1)
+                    + 2 * self.dims.max_contexts + 1
+                    + self.top_k * self.max_iters)
+            self._batched = make_batched_attack_steps(
+                self.dims, compute_dtype=self.compute_dtype,
+                topk_transfer=T)
+        eval_b, predict_b, score_topk_b = self._batched
+        legal_dev = jnp.asarray(self.legal)
+        M = len(methods)
+        src = np.stack([np.asarray(m[0]) for m in methods])
+        pth = np.stack([np.asarray(m[1]) for m in methods])
+        dst = np.stack([np.asarray(m[2]) for m in methods])
+        mask = np.stack([np.asarray(m[3]) for m in methods])
+        tok = np.array([self.attackable_tokens(src[i], dst[i],
+                                               mask[i])[0][0]
+                        for i in range(M)], np.int32)
+        occ_src = src == tok[:, None]
+        occ_dst = dst == tok[:, None]
+        occ = (jnp.asarray(occ_src), jnp.asarray(occ_dst))
+        spare = np.array([spare_row(rows, src[i], dst[i])
+                          for i in range(M)], np.int32)
+        labels = np.asarray(predict_b(
+            params, (jnp.asarray(src), jnp.asarray(pth),
+                     jnp.asarray(dst), jnp.asarray(mask)))).astype(
+                         np.int32)
+        original = labels.copy()
+
+        cur_src, cur_dst = src.copy(), dst.copy()
+        cur_id = tok.copy()
+        tried = [({int(tok[i])}
+                  | set(np.unique(np.concatenate(
+                      [src[i], dst[i]])).tolist()))
+                 for i in range(M)]
+        steps: List[List[RenameStep]] = [[] for _ in range(M)]
+        success = np.zeros((M,), bool)
+        done = np.zeros((M,), bool)
+        iters = np.zeros((M,), np.int32)
+        look = self.token_vocab.lookup_word
+
+        for _ in range(self.max_iters):
+            ids = (jnp.asarray(cur_src), jnp.asarray(pth),
+                   jnp.asarray(cur_dst), jnp.asarray(mask))
+            top_scores, top_ids = score_topk_b(
+                params, ids, occ, jnp.asarray(spare),
+                jnp.asarray(labels), -1.0, legal_dev)
+            top_scores = np.asarray(top_scores)
+            top_ids = np.asarray(top_ids)
+            cand = np.empty((M, self.top_k), np.int32)
+            for i in range(M):
+                # host-side: first K-1 untried, finite entries of the
+                # device top list (legality was masked on device); pad
+                # with cur_id when the list runs dry — those re-evaluate
+                # the current loss and can never be accepted (>= test)
+                cand[i, :] = cur_id[i]
+                if done[i]:
+                    continue
+                w = 0
+                for t, s in zip(top_ids[i], top_scores[i]):
+                    if w == self.top_k - 1 or np.isinf(s):
+                        break
+                    if int(t) not in tried[i]:
+                        cand[i, w] = int(t)
+                        w += 1
+            loss_k, top1_k = eval_b(params, ids, occ,
+                                    jnp.asarray(cand),
+                                    jnp.asarray(labels))
+            loss_k = np.asarray(loss_k)
+            top1_k = np.asarray(top1_k)
+            for i in range(M):
+                if done[i]:
+                    continue
+                att = -loss_k[i]
+                iters[i] += 1
+                best = int(np.argmin(att[:-1]))
+                tried[i].update(int(c) for c in cand[i])
+                if att[best] >= float(att[-1]):
+                    success[i] = attack_succeeded(
+                        False, int(top1_k[i, -1]), int(labels[i]),
+                        int(original[i]))
+                    done[i] = True
+                    continue
+                new_id = int(cand[i, best])
+                steps[i].append(RenameStep(
+                    from_token=look(int(cur_id[i])),
+                    to_token=look(new_id),
+                    loss_before=float(att[-1]),
+                    loss_after=float(att[best])))
+                cur_src[i] = np.where(occ_src[i], new_id, cur_src[i])
+                cur_dst[i] = np.where(occ_dst[i], new_id, cur_dst[i])
+                cur_id[i] = new_id
+                if attack_succeeded(False, int(top1_k[i, best]),
+                                    int(labels[i]), int(original[i])):
+                    success[i] = True
+                    done[i] = True
+            if done.all():
+                break
+
+        final_top1 = np.asarray(predict_b(
+            params, (jnp.asarray(cur_src), jnp.asarray(pth),
+                     jnp.asarray(cur_dst), jnp.asarray(mask))))
+        tv = self.target_vocab
+        return [AttackResult(
+            success=bool(success[i]), targeted=False,
+            original_prediction=tv.lookup_word(int(original[i])),
+            final_prediction=tv.lookup_word(int(final_top1[i])),
+            target_name=None,
+            renames=([(look(int(tok[i])), look(int(cur_id[i])))]
+                     if steps[i] else []),
+            steps=steps[i], iterations=int(iters[i]),
+            final_method=(cur_src[i], pth[i], cur_dst[i], mask[i]))
+            for i in range(M)]

@@ -55,21 +55,41 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
     labels, src, pth, dst, mask, tstr, _ = parse_c2v_rows(
         lines, model.vocabs, model.dims.max_contexts, keep_strings=True)
 
+    eligible = [i for i in range(len(lines))
+                if mask[i].sum() > 0
+                and attack.attackable_tokens(src[i], dst[i], mask[i])]
+    t0 = time.time()
+
+    def attacked():
+        """Yields (row_index, AttackResult). Single-rename sweeps run
+        the lockstep batch path — each jit dispatch covers a whole
+        chunk, which is what makes large sweeps fast on dispatch-bound
+        platforms; multi-rename falls back to the serial driver."""
+        if max_renames == 1:
+            chunk = 64
+            for lo in range(0, len(eligible), chunk):
+                idxs = eligible[lo:lo + chunk]
+                # pad a short tail chunk to the fixed size (repeat the
+                # last method, drop its results): one compiled shape,
+                # no retrace for the final partial batch
+                padded = idxs + [idxs[-1]] * (chunk - len(idxs))
+                methods = [(src[i], pth[i], dst[i], mask[i])
+                           for i in padded]
+                results = attack.attack_batch(model.params, methods)
+                yield from zip(idxs, results[:len(idxs)])
+        else:
+            for i in eligible:
+                yield i, attack.attack_method(
+                    model.params, (src[i], pth[i], dst[i], mask[i]),
+                    targeted=False, max_renames=max_renames)
+
     n = flipped = clean_correct = attacked_correct = 0
     iters_on_success, renames_on_success = [], []
     clean_scores, attack_scores = [], []
-    t0 = time.time()
-    for i in range(len(lines)):
-        if mask[i].sum() == 0:
-            continue
-        method = (src[i], pth[i], dst[i], mask[i])
-        if not attack.attackable_tokens(src[i], dst[i], mask[i]):
-            continue
-        res = attack.attack_method(model.params, method,
-                                   targeted=False,
-                                   max_renames=max_renames)
+    for i, res in attacked():
         if detector is not None:
-            clean_scores.append(detector.score(model.params, method))
+            clean_scores.append(detector.score(
+                model.params, (src[i], pth[i], dst[i], mask[i])))
             if res.success:
                 attack_scores.append(
                     detector.score(model.params, res.final_method))
@@ -81,7 +101,7 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
             flipped += 1
             iters_on_success.append(res.iterations)
             renames_on_success.append(len(res.renames))
-        if n % 25 == 0:
+        if n % 32 == 0:
             log(f"robustness: {n} methods, "
                 f"{flipped / n:.3f} attack success rate so far")
     dt = time.time() - t0

@@ -78,6 +78,29 @@ def test_untargeted_attack_flips_predictions(trained):
             assert r.final_prediction != r.original_prediction
 
 
+def test_batch_attack_matches_serial(trained):
+    """attack_batch is an optimization, not a different attack: same
+    success flags, renames, and final predictions as the serial driver
+    on the same methods."""
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=4)
+    _, methods = _test_methods(model, prefix, 10)
+    eligible = [m for m in methods
+                if attack.attackable_tokens(m[0], m[2], m[3])]
+    serial = [attack.attack_method(model.params, m, targeted=False,
+                                   max_renames=1) for m in eligible]
+    batch = attack.attack_batch(model.params, eligible)
+    assert len(batch) == len(serial)
+    for s, b in zip(serial, batch):
+        assert b.success == s.success
+        assert b.renames == s.renames
+        assert b.final_prediction == s.final_prediction
+        assert b.original_prediction == s.original_prediction
+        assert b.iterations == s.iterations
+        np.testing.assert_array_equal(b.final_method[0],
+                                      s.final_method[0])
+
+
 def test_attack_trajectory_monotone_and_consistent(trained):
     _, model, prefix = trained
     attack = _attack_for(model, max_iters=4)
