@@ -64,22 +64,41 @@ class RarityDetector:
 
         @jax.jit
         def attn_fn(params, src, pth, dst, mask):
-            _, attn = encode(params, src[None], pth[None], dst[None],
-                             mask[None], compute_dtype=compute_dtype)
-            return attn[0]
+            # batched [M, C]: one dispatch scores a whole sweep chunk
+            _, attn = encode(params, src, pth, dst, mask,
+                             compute_dtype=compute_dtype)
+            return attn
 
         self._attn_fn = attn_fn
+
+    _CHUNK = 64  # fixed batch shape: one jit compile, any M
+
+    def score_batch(self, params, methods) -> np.ndarray:
+        """Attention-weighted rarity of M tensorized methods, [M].
+        Internally padded to fixed-size chunks so the jitted attention
+        pass compiles once regardless of M."""
+        out = []
+        for lo in range(0, len(methods), self._CHUNK):
+            part = list(methods[lo:lo + self._CHUNK])
+            pad = self._CHUNK - len(part)
+            part += [part[-1]] * pad
+            src = np.stack([np.asarray(m[0]) for m in part])
+            pth = np.stack([np.asarray(m[1]) for m in part])
+            dst = np.stack([np.asarray(m[2]) for m in part])
+            mask = np.stack([np.asarray(m[3]) for m in part])
+            attn = np.asarray(self._attn_fn(
+                params, jnp.asarray(src), jnp.asarray(pth),
+                jnp.asarray(dst), jnp.asarray(mask)))
+            rar = np.maximum(self.rarity[src], self.rarity[dst])
+            scores = np.sum(attn * rar * (mask > 0), axis=1)
+            out.extend(scores[:self._CHUNK - pad])
+        return np.asarray(out)
 
     def score(self, params, method: Tuple[np.ndarray, np.ndarray,
                                           np.ndarray, np.ndarray]
               ) -> float:
         """Attention-weighted rarity of one tensorized method."""
-        src, pth, dst, mask = (np.asarray(a) for a in method)
-        attn = np.asarray(self._attn_fn(
-            params, jnp.asarray(src), jnp.asarray(pth),
-            jnp.asarray(dst), jnp.asarray(mask)))
-        rar = np.maximum(self.rarity[src], self.rarity[dst])
-        return float(np.sum(attn * rar * (mask > 0)))
+        return float(self.score_batch(params, [method])[0])
 
     @staticmethod
     def calibrate(clean_scores: np.ndarray, fpr: float = 0.05) -> float:

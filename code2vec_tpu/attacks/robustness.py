@@ -85,14 +85,12 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
 
     n = flipped = clean_correct = attacked_correct = 0
     iters_on_success, renames_on_success = [], []
-    clean_scores, attack_scores = [], []
+    clean_methods, adv_methods = [], []
     for i, res in attacked():
         if detector is not None:
-            clean_scores.append(detector.score(
-                model.params, (src[i], pth[i], dst[i], mask[i])))
+            clean_methods.append((src[i], pth[i], dst[i], mask[i]))
             if res.success:
-                attack_scores.append(
-                    detector.score(model.params, res.final_method))
+                adv_methods.append(res.final_method)
         n += 1
         truth = tv.lookup_word(int(labels[i])) if not tstr else tstr[i]
         clean_correct += res.original_prediction == truth
@@ -123,13 +121,15 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
         "top_k_candidates": top_k_candidates,
         "seconds": round(dt, 1),
     }
-    if detector is not None and attack_scores:
+    if detector is not None and adv_methods:
         from code2vec_tpu.attacks.detect import auc
-        thr = detector.calibrate(np.asarray(clean_scores), fpr=0.05)
-        report["detection_auc"] = round(
-            auc(np.asarray(clean_scores), np.asarray(attack_scores)), 4)
+        clean_scores = detector.score_batch(model.params, clean_methods)
+        attack_scores = detector.score_batch(model.params, adv_methods)
+        thr = detector.calibrate(clean_scores, fpr=0.05)
+        report["detection_auc"] = round(auc(clean_scores,
+                                            attack_scores), 4)
         report["detection_tpr_at_5fpr"] = round(
-            float(np.mean(np.asarray(attack_scores) > thr)), 4)
+            float(np.mean(attack_scores > thr)), 4)
         report["detection_threshold"] = round(thr, 3)
     return report
 
