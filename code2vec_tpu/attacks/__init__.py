@@ -23,8 +23,9 @@ from code2vec_tpu.attacks.source_attack import (SourceAttack,
                                                 SourceAttackResult)
 from code2vec_tpu.attacks.vm_attack import (VMAttackResult,
                                             VMGradientRenameAttack)
+from code2vec_tpu.attacks.vm_robustness import evaluate_vm_robustness
 
 __all__ = ["AttackResult", "GradientRenameAttack", "candidate_mask",
            "render_identifier", "SourceAttack", "SourceAttackResult",
            "evaluate_robustness", "VMAttackResult",
-           "VMGradientRenameAttack"]
+           "VMGradientRenameAttack", "evaluate_vm_robustness"]

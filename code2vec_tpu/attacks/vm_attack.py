@@ -147,6 +147,15 @@ class VMGradientRenameAttack:
         self.legal = candidate_mask(token_vocab,
                                     dims.padded(dims.token_vocab_size))
 
+    def attackable_slots(self, cand: np.ndarray, cmask: np.ndarray
+                         ) -> List[int]:
+        """Candidate slots whose token is a legal rename target (the
+        sweep filters rows with none — protocol parity with the
+        code2vec sweep's attackable_tokens check)."""
+        return [k for k in range(len(cand))
+                if cmask[k] > 0 and int(cand[k]) < len(self.legal)
+                and self.legal[int(cand[k])]]
+
     def attack_method(self, params, row, *, targeted: bool = False,
                       target_slot: Optional[int] = None,
                       max_renames: int = 1,
@@ -167,15 +176,12 @@ class VMGradientRenameAttack:
         else:
             label, sign = original, -1.0
 
-        # attackable = valid candidate slots whose token is a legal
-        # identifier, ordered by context-occurrence count
-        slots = []
-        for k in range(cand.shape[0]):
-            t = int(cand[k])
-            if cmask[k] > 0 and t < len(self.legal) and self.legal[t]:
-                occ = int((src == t).sum() + (dst == t).sum())
-                slots.append((occ, k))
-        slots.sort(reverse=True)
+        # attackable slots, ordered by context-occurrence count
+        slots = sorted(
+            ((int((src == int(cand[k])).sum()
+                  + (dst == int(cand[k])).sum()), k)
+             for k in self.attackable_slots(cand, cmask)),
+            reverse=True)
 
         cur = (src.copy(), pth, dst.copy(), mask, cand.copy(), cmask)
         renames: List[Tuple[int, int]] = []

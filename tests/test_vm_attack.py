@@ -83,6 +83,19 @@ def test_vm_targeted_attack_points_at_chosen_slot(vm_trained):
     assert hits >= 1, "targeted VM attack never reached its slot"
 
 
+def test_vm_robustness_report(vm_trained):
+    from code2vec_tpu.attacks.vm_robustness import evaluate_vm_robustness
+    _, model, prefix = vm_trained
+    report = evaluate_vm_robustness(
+        model, prefix + ".val.vm.c2v", n_methods=10, max_renames=1,
+        max_iters=3, log=lambda *_: None)
+    assert report["n_methods"] > 0
+    assert 0.0 <= report["attack_success_rate"] <= 1.0
+    assert report["robustness"] == pytest.approx(
+        1.0 - report["attack_success_rate"], abs=1e-6)
+    assert 0.0 <= report["clean_localization_acc"] <= 1.0
+
+
 def test_vm_attack_requires_slot_for_targeted(vm_trained):
     _, model, prefix = vm_trained
     attack = VMGradientRenameAttack(model.dims,
