@@ -86,11 +86,9 @@ def main() -> int:
         # Adversarial attack on --attack_input's source (the noamyft
         # fork delta; attacks/source_attack.py). The printed outcome is
         # the model's prediction on the REWRITTEN source, re-extracted.
-        from code2vec_tpu.attacks.source_attack import SourceAttack
-        from code2vec_tpu.common import split_to_subtokens
-        target = config.ATTACK_TARGET
-        if target and "|" not in target:
-            target = "|".join(split_to_subtokens(target))
+        from code2vec_tpu.attacks.source_attack import (
+            SourceAttack, normalize_target_name)
+        target = normalize_target_name(config.ATTACK_TARGET)
         attack = SourceAttack(config, model,
                               top_k_candidates=config.ATTACK_TOPK,
                               max_iters=config.ATTACK_ITERS)

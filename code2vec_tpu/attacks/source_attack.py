@@ -68,6 +68,16 @@ def normalize_identifier(ident: str) -> str:
     return "|".join(split_to_subtokens(ident))
 
 
+def normalize_target_name(name: Optional[str]) -> Optional[str]:
+    """CLI/REPL attack targets arrive as camelCase (`sortArray`) or
+    already in stored subtoken form (`sort|array`); normalize the
+    former. Shared by code2vec.py --attack_target and the REPL's
+    `attack <name>` command."""
+    if name and "|" not in name:
+        return normalize_identifier(name)
+    return name
+
+
 def declared_variables(source: str) -> List[str]:
     """Identifiers in declaration position (`Type name` followed by
     `= ; , ) :`): params, locals, fields. Heuristic — a regex, not a

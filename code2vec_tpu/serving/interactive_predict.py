@@ -26,7 +26,9 @@ class InteractivePredictor:
 
     def predict(self, input_file: str = DEFAULT_INPUT_FILE) -> None:
         print(f"Serving. Modify the file: \"{input_file}\", then press any "
-              f"key when ready, or \"q\" / \"quit\" / \"exit\" to exit.")
+              f"key when ready, or \"q\" / \"quit\" / \"exit\" to exit. "
+              f"Type \"attack\" (or \"attack <targetName>\") to search "
+              f"an adversarial rename for the current file.")
         while True:
             user_input = input()
             if user_input.strip().lower() in EXIT_KEYWORDS:
@@ -34,6 +36,11 @@ class InteractivePredictor:
                 return
             if not os.path.exists(input_file):
                 print(f"File not found: {input_file}")
+                continue
+            words = user_input.strip().split()
+            if words and words[0].lower() == "attack":
+                self._attack(input_file,
+                             words[1] if len(words) > 1 else None)
                 continue
             try:
                 _, lines = self.extractor.extract_paths(input_file)
@@ -53,3 +60,27 @@ class InteractivePredictor:
                 if res.code_vector is not None:
                     print("Code vector:")
                     print(" ".join(f"{x:.5f}" for x in res.code_vector))
+
+    def _attack(self, input_file: str, target: str) -> None:
+        """REPL `attack [targetName]` command: run the gradient rename
+        attack on the current file (attacks/source_attack.py) and print
+        the verified outcome."""
+        from code2vec_tpu.attacks.source_attack import (
+            SourceAttack, normalize_target_name)
+        if getattr(self, "_source_attack", None) is None:
+            # one instance per session: the jitted attack steps compile
+            # once; honors the same --attack_* knobs as the CLI driver
+            self._source_attack = SourceAttack(
+                self.config, self.model,
+                top_k_candidates=self.config.ATTACK_TOPK,
+                max_iters=self.config.ATTACK_ITERS)
+        target = normalize_target_name(target)
+        try:
+            result = self._source_attack.attack_file(
+                input_file, targeted=target is not None,
+                target_name=target,
+                max_renames=self.config.ATTACK_MAX_RENAMES)
+        except (ExtractorError, ValueError) as e:
+            print(f"Attack error: {e}")
+            return
+        print(str(result))

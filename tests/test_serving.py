@@ -43,7 +43,8 @@ def test_repl_scripted_session(tmp_path, monkeypatch, capsys):
     with open(input_file, "w") as f:
         f.write(JAVA_SRC)
 
-    answers = iter(["", "q"])  # one prediction round, then exit
+    # one prediction round, one attack round, then exit
+    answers = iter(["", "attack", "q"])
     monkeypatch.setattr("builtins.input", lambda: next(answers))
     InteractivePredictor(cfg, model).predict(input_file=input_file)
 
@@ -53,4 +54,7 @@ def test_repl_scripted_session(tmp_path, monkeypatch, capsys):
     assert "predicted:" in out
     assert "Attention:" in out
     assert "context:" in out
+    # the REPL attack command printed an AttackResult (or a clean
+    # attack error — never a traceback)
+    assert "untargeted" in out or "Attack error:" in out
     assert "Exiting..." in out
