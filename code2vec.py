@@ -61,12 +61,15 @@ def main() -> int:
                       file=sys.stderr)
                 return 2
             config.HEAD = ckpt_head
-    # Config.verify() ran before the manifest could set HEAD; re-check
-    # the head-dependent guard now that the effective head is known.
-    if config.ATTACK and config.HEAD == "varmisuse":
-        print("error: --attack applies to the code2vec head only "
-              "(checkpoint was trained with --head varmisuse)",
-              file=sys.stderr)
+    # Config.verify() ran before the manifest could set HEAD; re-run it
+    # now that the effective head is known — varmisuse checkpoints must
+    # reject the code2vec-only surfaces (--predict/--release/--attack/
+    # --save_w2v/--save_t2v/--export_code_vectors) with a clean error,
+    # not a downstream crash.
+    try:
+        config.verify()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
         return 2
 
     from code2vec_tpu.serving.interactive_predict import InteractivePredictor
@@ -92,13 +95,17 @@ def main() -> int:
         attack = SourceAttack(config, model,
                               top_k_candidates=config.ATTACK_TOPK,
                               max_iters=config.ATTACK_ITERS)
-        result = attack.attack_file(
-            config.ATTACK_INPUT,
-            method_index=config.ATTACK_METHOD_INDEX,
-            targeted=config.ATTACK == "targeted",
-            target_name=target,
-            max_renames=config.ATTACK_MAX_RENAMES,
-            deadcode=config.ATTACK_DEADCODE)
+        try:
+            result = attack.attack_file(
+                config.ATTACK_INPUT,
+                method_index=config.ATTACK_METHOD_INDEX,
+                targeted=config.ATTACK == "targeted",
+                target_name=target,
+                max_renames=config.ATTACK_MAX_RENAMES,
+                deadcode=config.ATTACK_DEADCODE)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         print(str(result))
         # only a VERIFIED success earns the .adversarial artifact —
         # scripts treat the file's existence as the success signal

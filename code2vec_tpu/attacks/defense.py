@@ -61,12 +61,17 @@ def make_rename_augment(legal: np.ndarray, prob: float) -> Callable:
         labels, src, pth, dst, mask, weights = batch
         B = src.shape[0]
         r_slot, r_new, r_apply = jax.random.split(rng, 3)
-        # one valid, legal-token slot per example (all-padding rows have
-        # weight 0 — whatever categorical returns there is never counted)
-        eligible = (mask > 0) & legal_mask[src]
+        # one valid, legal-token slot per example, drawn over BOTH
+        # context sides — a variable can survive only in dst slots
+        # after downsampling, and the attack renames either side, so
+        # the defense must too (all-padding rows have weight 0 —
+        # whatever categorical returns there is never counted)
+        all_tok = jnp.concatenate([src, dst], axis=1)       # [B, 2C]
+        all_mask = jnp.concatenate([mask, mask], axis=1)
+        eligible = (all_mask > 0) & legal_mask[all_tok]
         slot_logits = jnp.where(eligible, 0.0, -1e9)
         j = jax.random.categorical(r_slot, slot_logits, axis=-1)
-        tok = jnp.take_along_axis(src, j[:, None], axis=1)[:, 0]
+        tok = jnp.take_along_axis(all_tok, j[:, None], axis=1)[:, 0]
         new = legal[jax.random.randint(r_new, (B,), 0, legal.shape[0])]
         keep = (jax.random.bernoulli(r_apply, prob, (B,))
                 & legal_mask[tok])  # no-legal-slot rows stay unchanged

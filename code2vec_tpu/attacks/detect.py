@@ -22,7 +22,6 @@ tools/robustness_study.py --detect; results in BASELINE.md.
 
 from __future__ import annotations
 
-import pickle
 from typing import Dict, Tuple
 
 import jax
@@ -30,14 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from code2vec_tpu.models.encoder import ModelDims, get_encode_fn
-from code2vec_tpu.vocab.vocabularies import Vocab
+from code2vec_tpu.vocab.vocabularies import Vocab, read_count_dicts
 
 
 def load_token_counts(dict_path: str) -> Dict[str, int]:
-    """Token histogram from the dataset's `.dict.c2v` pickle (first
-    object — SURVEY.md §3.2 dict order)."""
-    with open(dict_path, "rb") as f:
-        return pickle.load(f)
+    """Token histogram from the dataset's `.dict.c2v` (the pickle
+    layout is owned by vocabularies.read_count_dicts)."""
+    return read_count_dicts(dict_path)[0]
 
 
 class RarityDetector:
@@ -76,11 +74,14 @@ class RarityDetector:
     def score_batch(self, params, methods) -> np.ndarray:
         """Attention-weighted rarity of M tensorized methods, [M].
         Internally padded to fixed-size chunks so the jitted attention
-        pass compiles once regardless of M."""
+        pass compiles once regardless of M (single-method calls get a
+        batch-1 shape — the serving path must not pay 64x encode work
+        per prediction)."""
+        chunk = 1 if len(methods) == 1 else self._CHUNK
         out = []
-        for lo in range(0, len(methods), self._CHUNK):
-            part = list(methods[lo:lo + self._CHUNK])
-            pad = self._CHUNK - len(part)
+        for lo in range(0, len(methods), chunk):
+            part = list(methods[lo:lo + chunk])
+            pad = chunk - len(part)
             part += [part[-1]] * pad
             src = np.stack([np.asarray(m[0]) for m in part])
             pth = np.stack([np.asarray(m[1]) for m in part])
@@ -91,7 +92,7 @@ class RarityDetector:
                 jnp.asarray(dst), jnp.asarray(mask)))
             rar = np.maximum(self.rarity[src], self.rarity[dst])
             scores = np.sum(attn * rar * (mask > 0), axis=1)
-            out.extend(scores[:self._CHUNK - pad])
+            out.extend(scores[:chunk - pad])
         return np.asarray(out)
 
     def score(self, params, method: Tuple[np.ndarray, np.ndarray,

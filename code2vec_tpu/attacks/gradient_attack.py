@@ -118,12 +118,11 @@ def build_shortlist(scores: np.ndarray, legal: np.ndarray, tried: set,
     cand = np.empty((top_k,), np.int32)
     # argpartition: O(V) selection beats a full argsort (~8x at the
     # java-large 1.3M-row vocab); order within the shortlist does not
-    # matter — every entry is exactly re-scored anyway
+    # matter — every entry is exactly re-scored anyway. Both attack
+    # constructors clamp top_k <= vocab rows, making kth valid.
     k = top_k - 1
-    if k < len(scores):
-        cand[:-1] = np.argpartition(scores, k)[:k]
-    else:
-        cand[:-1] = np.argsort(scores)[:k]
+    assert k < len(scores), "top_k exceeds the vocabulary"
+    cand[:-1] = np.argpartition(scores, k)[:k]
     cand[-1] = cur_id
     return cand
 
@@ -473,6 +472,13 @@ class GradientRenameAttack:
         iters = 0
         success = False
         for tid in token_ids:
+            # a requested token can be absent from the tensorized
+            # method (dead-code driver after MAX_CONTEXTS downsampling
+            # dropped the inserted declaration's contexts): with no
+            # occurrence slots the gradient is identically zero, so
+            # skip instead of burning iterations on a no-op
+            if not ((cur[0] == tid).any() or (cur[2] == tid).any()):
+                continue
             ok, final_id, steps, used = self.attack_token(
                 params, cur, tid, targeted=targeted, label=label,
                 original_top1=original_top1, forbidden=forbidden)

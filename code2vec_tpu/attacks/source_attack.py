@@ -392,6 +392,13 @@ class SourceAttack:
             # dead-code mode: attack exactly the inserted variable
             tid = self.attack.token_vocab.lookup_index(
                 normalize_identifier(token_ids_from))
+            if not ((method[0] == tid).any()
+                    or (method[2] == tid).any()):
+                raise ValueError(
+                    "the inserted dead declaration's contexts were all "
+                    "dropped by MAX_CONTEXTS downsampling (method has "
+                    "more contexts than fit); raise --max_contexts to "
+                    "attack this method with dead code")
             token_ids = [tid]
         else:
             # rename mode: only tokens that map to a DECLARED variable

@@ -172,6 +172,12 @@ class VMGradientRenameAttack:
         if targeted:
             if target_slot is None:
                 raise ValueError("targeted VM attack needs a slot")
+            if not 0 <= int(target_slot) < len(cmask) \
+                    or cmask[int(target_slot)] == 0:
+                raise ValueError(
+                    f"target slot {target_slot} is not a live candidate "
+                    f"(K={len(cmask)}, "
+                    f"{int((cmask > 0).sum())} valid slots)")
             label, sign = int(target_slot), 1.0
         else:
             label, sign = original, -1.0

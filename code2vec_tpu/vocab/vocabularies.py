@@ -110,17 +110,9 @@ class Code2VecVocabs:
     def load_from_dict_file(cls, dict_path: str, max_token_vocab_size: int,
                             max_path_vocab_size: int,
                             max_target_vocab_size: int) -> "Code2VecVocabs":
-        """Load the `.dict.c2v` pickle written by preprocess
-        (SURVEY.md §3.2: token dict, path dict, target dict, num_examples,
-        pickled sequentially in that order)."""
-        with open(dict_path, "rb") as f:
-            token_counts = pickle.load(f)
-            path_counts = pickle.load(f)
-            target_counts = pickle.load(f)
-            try:
-                num_examples = pickle.load(f)
-            except EOFError:
-                num_examples = None
+        """Load the `.dict.c2v` pickle written by preprocess."""
+        (token_counts, path_counts, target_counts,
+         num_examples) = read_count_dicts(dict_path)
         return cls(
             Vocab.create_from_freq_dict(VocabType.Token, token_counts,
                                         max_token_vocab_size),
@@ -152,3 +144,20 @@ class Code2VecVocabs:
             Vocab.from_word_list(VocabType.Target, d["target"]),
             num_training_examples=d.get("num_training_examples"),
         )
+
+
+def read_count_dicts(dict_path: str):
+    """The `.dict.c2v` sequential-pickle layout, owned HERE
+    (SURVEY.md §3.2: token dict, path dict, target dict, num_examples,
+    pickled in that order). Every consumer of the raw histograms
+    (vocab construction, attacks/detect.py rarity tables) goes through
+    this single reader."""
+    with open(dict_path, "rb") as f:
+        token_counts = pickle.load(f)
+        path_counts = pickle.load(f)
+        target_counts = pickle.load(f)
+        try:
+            num_examples = pickle.load(f)
+        except EOFError:
+            num_examples = None
+    return token_counts, path_counts, target_counts, num_examples

@@ -48,3 +48,18 @@ def test_tail_mode_adds_distractors_and_keeps_default_stream(tmp_path):
     # compounds while tail does
     assert any(s in tail for s in ("tmpBuf", "bufAcc", "locRef",
                                    "idxPtr", "accCur", "curAux"))
+
+
+def test_tail_mode_emits_no_unreachable_statements(tmp_path):
+    """Tail-mode insertions must land BEFORE a method's trailing return
+    (javac rejects statements after it); scan every generated body."""
+    _gen(str(tmp_path / "t"), "--tail_names", "100")
+    for dirpath, _, files in os.walk(tmp_path / "t"):
+        for fn in files:
+            with open(os.path.join(dirpath, fn)) as f:
+                lines = [ln.strip() for ln in f]
+            for i, ln in enumerate(lines[:-1]):
+                if ln.startswith("return"):
+                    nxt = lines[i + 1]
+                    assert nxt in ("}", "") or nxt.startswith("}"), \
+                        f"{fn}: statement after {ln!r}: {nxt!r}"

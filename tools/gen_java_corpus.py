@@ -119,16 +119,29 @@ def method_source(rng: random.Random, verb: str, adj: str,
                  f"  int {field} = x * 2 + {d2};",
                  f"  if ({field} > x) {{ {field} -= 1; }}",
                  f"  return {field};", "}"]
-    if rng.random() < 0.3:
-        lines.insert(-1, f"  int {distract} = {d2} + 1;")
+    extra = ([f"  int {distract} = {d2} + 1;"]
+             if rng.random() < 0.3 else [])
     if tail_pool:
-        # insert BEFORE a trailing return (javac-valid placement) and
-        # sample junk names WITHOUT replacement (no duplicate locals)
-        at = -2 if lines[-2].lstrip().startswith("return") else -1
-        extra = [f"  int {field}Copy = {field} + 0;"]
+        # tail mode inserts EVERYTHING before the last return statement
+        # (javac-valid placement), junk names sampled WITHOUT
+        # replacement (no duplicate locals)
+        at = len(lines) - 1
+        for idx in range(len(lines) - 1, -1, -1):
+            if lines[idx].lstrip().startswith("return"):
+                at = idx
+                break
+        extra += [f"  int {field}Copy = {field} + 0;"]
         extra += [f"  int {junk} = {rng.randrange(9)};"
                   for junk in rng.sample(tail_pool, rng.randint(2, 3))]
         lines[at:at] = extra
+    else:
+        # default mode keeps the historical before-brace placement —
+        # it can land after a trailing return (extractor-only corpus;
+        # javac-correctness is a tail-mode property), and moving it
+        # would break the byte-identical-rebuild anchor the quality
+        # study's reproducibility claim rests on
+        for e in extra:
+            lines.insert(-1, e)
     return "\n".join("  " + ln for ln in lines)
 
 
