@@ -29,13 +29,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from code2vec_tpu.models.encoder import ModelDims, get_encode_fn
-from code2vec_tpu.vocab.vocabularies import Vocab, read_count_dicts
+from code2vec_tpu.vocab.vocabularies import Vocab, read_token_counts
 
 
 def load_token_counts(dict_path: str) -> Dict[str, int]:
     """Token histogram from the dataset's `.dict.c2v` (the pickle
-    layout is owned by vocabularies.read_count_dicts)."""
-    return read_count_dicts(dict_path)[0]
+    layout is owned by vocabularies.py; only the token dict is
+    deserialized — the ~1M-entry path/target dicts are skipped)."""
+    return read_token_counts(dict_path)
 
 
 class RarityDetector:

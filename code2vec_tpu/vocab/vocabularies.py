@@ -161,3 +161,12 @@ def read_count_dicts(dict_path: str):
         except EOFError:
             num_examples = None
     return token_counts, path_counts, target_counts, num_examples
+
+
+def read_token_counts(dict_path: str) -> Dict[str, int]:
+    """Just the token histogram (the FIRST pickled object — layout
+    owned by read_count_dicts above): consumers that only need token
+    frequencies (attacks/detect.py) skip deserializing the ~1M-entry
+    path/target dicts."""
+    with open(dict_path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,112 @@
+"""TF-checkpoint importer (tools/import_tf_checkpoint.py): a
+reference-style tf.train.Saver checkpoint (the five variables of
+SURVEY.md §3's tensorflow_model row) must import into a released
+checkpoint this framework loads and serves, with the weights carried
+over exactly."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from code2vec_tpu.models.jax_model import Code2VecModel  # noqa: E402
+from tests.helpers import build_tiny_dataset  # noqa: E402
+from tests.test_model import tiny_config  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IMPORTER = os.path.join(REPO, "tools", "import_tf_checkpoint.py")
+
+
+def _write_reference_style_checkpoint(d, Vt, Vp, Vy, E):
+    """A TF1-Saver checkpoint with the reference's variable names (plus
+    Adam slots, which the importer must skip)."""
+    import tensorflow.compat.v1 as tf1
+    rng = np.random.default_rng(0)
+    arrays = {
+        "model/WORDS_VOCAB": rng.normal(size=(Vt, E)),
+        "model/PATHS_VOCAB": rng.normal(size=(Vp, E)),
+        "model/TARGET_WORDS_VOCAB": rng.normal(size=(Vy, 3 * E)),
+        "model/TRANSFORM": rng.normal(size=(3 * E, 3 * E)),
+        "model/ATTENTION": rng.normal(size=(3 * E, 1)),
+    }
+    g = tf1.Graph()
+    with g.as_default():
+        for name, arr in arrays.items():
+            v = tf1.get_variable(name, shape=arr.shape,
+                                 dtype=tf1.float32)
+            # fake Adam slot vars the importer must NOT confuse with
+            # the weights
+            tf1.get_variable(name + "/Adam", shape=arr.shape,
+                             dtype=tf1.float32)
+        saver = tf1.train.Saver()
+        with tf1.Session(graph=g) as s:
+            s.run(tf1.global_variables_initializer())
+            for name, arr in arrays.items():
+                var = [v for v in tf1.global_variables()
+                       if v.name == name + ":0"][0]
+                s.run(var.assign(arr.astype(np.float32)))
+            prefix = saver.save(s, os.path.join(d, "model"))
+    return prefix, arrays
+
+
+def test_import_reference_tf_checkpoint(tmp_path):
+    # dataset supplies the .dict.c2v whose vocab sizes the TF tables
+    # must match (vocab sizes INCLUDE the two special rows)
+    (tmp_path / "ds").mkdir()
+    prefix = build_tiny_dataset(str(tmp_path / "ds"), n_train=128,
+                                n_val=16, n_test=16, max_contexts=16)
+    cfg = tiny_config(prefix)
+    probe = Code2VecModel(cfg)  # just to learn the vocab sizes
+    Vt = probe.vocabs.token_vocab.size
+    Vp = probe.vocabs.path_vocab.size
+    Vy = probe.vocabs.target_vocab.size
+    E = 16
+
+    tf_prefix, arrays = _write_reference_style_checkpoint(
+        str(tmp_path / "tfckpt"), Vt, Vp, Vy, E)
+    out_dir = str(tmp_path / "imported")
+    r = subprocess.run(
+        [sys.executable, IMPORTER, "--tf_checkpoint", tf_prefix,
+         "--dict", prefix + ".dict.c2v", "--save", out_dir,
+         "--max_contexts", "16",
+         "--word_vocab_size", "1000", "--path_vocab_size", "1000",
+         "--target_vocab_size", "1000"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "imported TF checkpoint" in r.stdout
+
+    # the imported checkpoint loads as a released model and serves
+    cfg2 = tiny_config(prefix)
+    cfg2.train_data_path = None
+    cfg2.load_path = out_dir
+    cfg2.test_data_path = prefix + ".test.c2v"
+    model = Code2VecModel(cfg2)
+    # weights carried over exactly
+    np.testing.assert_allclose(
+        np.asarray(model.params["token_emb"], np.float32),
+        arrays["model/WORDS_VOCAB"].astype(np.float32), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(model.params["attention"], np.float32),
+        arrays["model/ATTENTION"][:, 0].astype(np.float32), atol=1e-6)
+    results = model.evaluate()  # untrained weights — just must run
+    assert results.subtoken_f1 >= 0.0
+
+
+def test_import_rejects_shape_mismatch(tmp_path):
+    (tmp_path / "ds").mkdir()
+    prefix = build_tiny_dataset(str(tmp_path / "ds"), n_train=128,
+                                n_val=16, n_test=16, max_contexts=16)
+    tf_prefix, _ = _write_reference_style_checkpoint(
+        str(tmp_path / "tfckpt"), 7, 5, 4, 16)  # wrong row counts
+    r = subprocess.run(
+        [sys.executable, IMPORTER, "--tf_checkpoint", tf_prefix,
+         "--dict", prefix + ".dict.c2v", "--save",
+         str(tmp_path / "out"), "--word_vocab_size", "1000",
+         "--path_vocab_size", "1000", "--target_vocab_size", "1000"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode != 0
+    assert "does not match" in r.stderr

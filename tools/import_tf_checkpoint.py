@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Import a reference TensorFlow checkpoint into this framework.
+
+The reference ships pretrained TF models — `tf.train.Saver` checkpoints
+of exactly five trainable variables (SURVEY.md §3 `tensorflow_model.py`
+row): WORDS_VOCAB [Vt,E], PATHS_VOCAB [Vp,E], TARGET_WORDS_VOCAB
+[Vy,3E], TRANSFORM [3E,3E], ATTENTION [3E,1]. This tool maps them onto
+this framework's param pytree and writes a loadable RELEASED checkpoint
+(inference-ready, fresh optimizer state on resume) plus the vocab
+sidecar, so a reference user's trained model transfers without
+retraining:
+
+  python tools/import_tf_checkpoint.py \
+      --tf_checkpoint <ckpt_prefix_or_dir> --dict <data.dict.c2v> \
+      --save <out_ckpt_dir> [--max_contexts 200] \
+      [--word_vocab_size N] [--path_vocab_size N] [--target_vocab_size N]
+
+Then: python code2vec.py --load <out_ckpt_dir> --predict   (etc.)
+
+Caveats, stated rather than hidden (SURVEY.md §0: the reference mount
+was empty, so exact variable scopes are [M] confidence): variables are
+located by NAME SUBSTRING, tolerant of scope prefixes; every mapped
+array is shape-checked against the vocab sizes derived from --dict, and
+a mismatch is a loud error naming both shapes — run with the same vocab
+size flags the model was trained with.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# substring -> param key, in MOST-SPECIFIC-FIRST order: WORDS_VOCAB is
+# a substring of TARGET_WORDS_VOCAB, so the target table must match
+# before the token table is considered
+_VAR_MAP = (
+    ("TARGET_WORDS_VOCAB", "target_emb"),
+    ("PATHS_VOCAB", "path_emb"),
+    ("WORDS_VOCAB", "token_emb"),
+    ("TRANSFORM", "transform"),
+    ("ATTENTION", "attention"),
+)
+
+
+def locate_variables(reader) -> dict:
+    """checkpoint variable name -> param key, by substring match."""
+    names = list(reader.get_variable_to_shape_map())
+    mapping = {}
+    for sub, key in _VAR_MAP:
+        hits = [n for n in names
+                if sub in n and n not in mapping
+                # Adam slot variables shadow the weights
+                and not n.endswith(("/Adam", "/Adam_1"))]
+        if not hits:
+            raise SystemExit(
+                f"error: no checkpoint variable matches '{sub}' "
+                f"(have: {sorted(names)[:10]}...)")
+        if len(hits) > 1:
+            raise SystemExit(
+                f"error: ambiguous match for '{sub}': {hits}")
+        mapping[hits[0]] = key
+    return mapping
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--tf_checkpoint", required=True,
+                    help="TF checkpoint prefix (or its directory)")
+    ap.add_argument("--dict", dest="dict_path", required=True,
+                    help="the dataset's .dict.c2v (reference releases "
+                         "ship it next to the model)")
+    ap.add_argument("--save", required=True, help="output checkpoint dir")
+    ap.add_argument("--max_contexts", type=int, default=200)
+    ap.add_argument("--word_vocab_size", type=int, default=1_301_136)
+    ap.add_argument("--path_vocab_size", type=int, default=911_417)
+    ap.add_argument("--target_vocab_size", type=int, default=261_245)
+    a = ap.parse_args()
+
+    import numpy as np
+    import tensorflow as tf
+
+    from code2vec_tpu.models.encoder import ModelDims
+    from code2vec_tpu.training import checkpoint as ckpt
+    from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+
+    vocabs = Code2VecVocabs.load_from_dict_file(
+        a.dict_path, a.word_vocab_size, a.path_vocab_size,
+        a.target_vocab_size)
+
+    path = a.tf_checkpoint
+    if os.path.isdir(path):
+        found = tf.train.latest_checkpoint(path)
+        if found is None:
+            raise SystemExit(f"error: no TF checkpoint under {path}")
+        path = found
+    reader = tf.train.load_checkpoint(path)
+    mapping = locate_variables(reader)
+
+    params = {}
+    for var_name, key in mapping.items():
+        arr = np.asarray(reader.get_tensor(var_name), np.float32)
+        if key == "attention" and arr.ndim == 2:
+            arr = arr[:, 0]
+        params[key] = arr
+        print(f"  {var_name} {list(arr.shape)} -> {key}")
+
+    E = params["token_emb"].shape[1]
+    dims = ModelDims(
+        token_vocab_size=vocabs.token_vocab.size,
+        path_vocab_size=vocabs.path_vocab.size,
+        target_vocab_size=vocabs.target_vocab.size,
+        embeddings_size=E, max_contexts=a.max_contexts,
+        tables_dtype="float32")  # imported weights stay exact
+
+    expected = {
+        "token_emb": (dims.token_vocab_size, E),
+        "path_emb": (dims.path_vocab_size, E),
+        "target_emb": (dims.target_vocab_size, 3 * E),
+        "transform": (3 * E, 3 * E),
+        "attention": (3 * E,),
+    }
+    for key, shape in expected.items():
+        got = params[key].shape
+        if tuple(got) != shape:
+            raise SystemExit(
+                f"error: {key} shape {list(got)} does not match "
+                f"{list(shape)} derived from --dict and the vocab size "
+                f"flags — re-run with the vocab sizes the reference "
+                f"model was trained with (its training logs / "
+                f"preprocess.sh record them)")
+
+    os.makedirs(a.save, exist_ok=True)
+    # a released checkpoint stores {"params"} ONLY (the loader restores
+    # against that exact template and re-inits optimizer state) — match
+    # release_checkpoint's structure, not the full training state
+    state = {"params": params}
+    ckpt.save_checkpoint(
+        a.save, state, 0, vocabs, dims,
+        extra_manifest={
+            "released": True,
+            "use_sampled_softmax": False,
+            "sparse_embedding_updates": False,
+            "embedding_optimizer": "adam",
+            "lr_schedule": "constant",
+            "imported_from": os.path.abspath(path),
+        }, max_to_keep=1)
+    print(f"imported TF checkpoint -> {a.save} (released; "
+          f"`python code2vec.py --load {a.save} --predict` to serve)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
