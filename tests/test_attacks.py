@@ -139,6 +139,24 @@ def test_targeted_attack_reaches_target(trained):
         f"targeted attack hit {hits}/{tried} — gradient guidance broken?"
 
 
+def test_attack_works_on_model_sharded_params(trained):
+    """The attack's jitted steps must follow the params' NamedSharding
+    (TP-sharded vocab tables) — jit partitions around the spare-row
+    update and the [V,E]@[E] matvec without host-side changes."""
+    _, _, prefix = trained
+    cfg = tiny_config(prefix, MESH_MODEL_AXIS=2, NUM_TRAIN_EPOCHS=2)
+    model = Code2VecModel(cfg)
+    model.train()
+    attack = _attack_for(model, max_iters=3)
+    _, methods = _test_methods(model, prefix, 4)
+    for m in methods:
+        r = attack.attack_method(model.params, m, targeted=False,
+                                 max_renames=1)
+        assert r.original_prediction  # ran end-to-end on sharded params
+    batch = attack.attack_batch(model.params, methods)
+    assert len(batch) == len(methods)
+
+
 def test_robustness_report(trained):
     _, model, prefix = trained
     report = evaluate_robustness(model, prefix + ".test.c2v",

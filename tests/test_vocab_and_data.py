@@ -41,6 +41,20 @@ def test_preprocess_and_dict_roundtrip(tmp_path):
             assert len(line.rstrip("\n").split(" ")) == 11
 
 
+def test_count_dict_readers_agree(tmp_path):
+    """read_count_dicts and the token-only fast reader must expose the
+    same .dict.c2v layout (attacks/detect.py depends on the latter)."""
+    from code2vec_tpu.vocab.vocabularies import (read_count_dicts,
+                                                 read_token_counts)
+    prefix = build_tiny_dataset(str(tmp_path), n_train=50, n_val=8,
+                                n_test=8, max_contexts=10)
+    tok, pth, tgt, n = read_count_dicts(prefix + ".dict.c2v")
+    assert read_token_counts(prefix + ".dict.c2v") == tok
+    assert n == 50
+    assert tok and pth and tgt
+    assert all(isinstance(c, int) for c in tok.values())
+
+
 def test_parse_c2v_rows_edge_cases():
     vocabs = Code2VecVocabs(
         Vocab(VocabType.Token, ["foo", "bar"]),

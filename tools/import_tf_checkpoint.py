@@ -99,6 +99,37 @@ def main() -> int:
         path = found
     reader = tf.train.load_checkpoint(path)
     mapping = locate_variables(reader)
+    shapes = reader.get_variable_to_shape_map()
+
+    # validate BEFORE materializing: a java-large checkpoint is >1.5 GB
+    # and a vocab-size mismatch should fail in milliseconds, not after
+    # reading every table
+    by_key = {key: var for var, key in mapping.items()}
+    E = shapes[by_key["token_emb"]][1]
+    dims = ModelDims(
+        token_vocab_size=vocabs.token_vocab.size,
+        path_vocab_size=vocabs.path_vocab.size,
+        target_vocab_size=vocabs.target_vocab.size,
+        embeddings_size=E, max_contexts=a.max_contexts,
+        tables_dtype="float32")  # imported weights stay exact
+    expected = {
+        "token_emb": [dims.token_vocab_size, E],
+        "path_emb": [dims.path_vocab_size, E],
+        "target_emb": [dims.target_vocab_size, 3 * E],
+        "transform": [3 * E, 3 * E],
+        # the reference stores ATTENTION as [3E, 1]; squeezed on load
+        "attention": [3 * E, 1],
+    }
+    for key, shape in expected.items():
+        got = list(shapes[by_key[key]])
+        if got != shape and not (key == "attention"
+                                 and got == shape[:1]):
+            raise SystemExit(
+                f"error: {by_key[key]} shape {got} does not match "
+                f"{shape} derived from --dict and the vocab size "
+                f"flags — re-run with the vocab sizes the reference "
+                f"model was trained with (its training logs / "
+                f"preprocess.sh record them)")
 
     params = {}
     for var_name, key in mapping.items():
@@ -107,31 +138,6 @@ def main() -> int:
             arr = arr[:, 0]
         params[key] = arr
         print(f"  {var_name} {list(arr.shape)} -> {key}")
-
-    E = params["token_emb"].shape[1]
-    dims = ModelDims(
-        token_vocab_size=vocabs.token_vocab.size,
-        path_vocab_size=vocabs.path_vocab.size,
-        target_vocab_size=vocabs.target_vocab.size,
-        embeddings_size=E, max_contexts=a.max_contexts,
-        tables_dtype="float32")  # imported weights stay exact
-
-    expected = {
-        "token_emb": (dims.token_vocab_size, E),
-        "path_emb": (dims.path_vocab_size, E),
-        "target_emb": (dims.target_vocab_size, 3 * E),
-        "transform": (3 * E, 3 * E),
-        "attention": (3 * E,),
-    }
-    for key, shape in expected.items():
-        got = params[key].shape
-        if tuple(got) != shape:
-            raise SystemExit(
-                f"error: {key} shape {list(got)} does not match "
-                f"{list(shape)} derived from --dict and the vocab size "
-                f"flags — re-run with the vocab sizes the reference "
-                f"model was trained with (its training logs / "
-                f"preprocess.sh record them)")
 
     os.makedirs(a.save, exist_ok=True)
     # a released checkpoint stores {"params"} ONLY (the loader restores
