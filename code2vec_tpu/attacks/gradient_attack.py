@@ -516,7 +516,17 @@ class GradientRenameAttack:
         the WHOLE batch. On the tunneled platform, where fixed dispatch
         cost dominates the serial sweep, this is what makes
         test-set-scale robustness sweeps fast. Methods must each have
-        at least one attackable token (the sweep filters first)."""
+        at least one attackable token (the sweep filters first).
+
+        Equivalence caveat: the serial path shortlists via argpartition
+        (arbitrary order within the partition) while this path uses a
+        sorted device top_k, so an EXACT float tie in first-order scores
+        at the shortlist boundary can admit different candidate sets —
+        and, since acceptance re-scores exactly, potentially a different
+        accepted rename. Ties at f32 gradient-score precision do not
+        occur on the tested corpora (the equivalence test passes
+        bit-for-bit), but the guarantee is "identical absent score
+        ties", not unconditional."""
         rows = self.dims.padded(self.dims.token_vocab_size)
         if self._batched is None:
             # top-T transfer bound: the host drops tried ids from the
@@ -536,9 +546,15 @@ class GradientRenameAttack:
         pth = np.stack([np.asarray(m[1]) for m in methods])
         dst = np.stack([np.asarray(m[2]) for m in methods])
         mask = np.stack([np.asarray(m[3]) for m in methods])
-        tok = np.array([self.attackable_tokens(src[i], dst[i],
-                                               mask[i])[0][0]
-                        for i in range(M)], np.int32)
+        tok_lists = [self.attackable_tokens(src[i], dst[i], mask[i])
+                     for i in range(M)]
+        for i, tl in enumerate(tok_lists):
+            if len(tl) == 0:
+                raise ValueError(
+                    f"method {i} has no attackable tokens; filter with "
+                    "attackable_tokens first (robustness.py's sweep "
+                    "does this)")
+        tok = np.array([tl[0][0] for tl in tok_lists], np.int32)
         occ_src = src == tok[:, None]
         occ_dst = dst == tok[:, None]
         occ = (jnp.asarray(occ_src), jnp.asarray(occ_dst))

@@ -50,6 +50,14 @@ class Config:
     # shipped default (sampled+bf16+adafactor+cosine, 0.9273) beats the
     # reference-style constant-LR full softmax (0.9252).
     LR_SCHEDULE: str = "cosine"
+    # "warmup_cosine" warmup length; 0 = auto (5% of total steps).
+    # Only meaningful with --lr_schedule warmup_cosine (the
+    # large-global-batch recipe; BASELINE.md round-4 study).
+    LR_WARMUP_STEPS: int = 0
+    # LAMB-style per-array trust-ratio rescale on every optimizer
+    # branch (training/optimizers.make_optimizer). Changes opt_state
+    # structure -> recorded in the checkpoint manifest.
+    TRUST_RATIO: bool = False
     SEED: int = 239
 
     # ---- softmax strategy (TPU addition; SURVEY.md §3.3 requires sampled
@@ -92,6 +100,12 @@ class Config:
     # at B=1024). Default on; it only takes effect on a TPU backend
     # (the model silently falls back to the XLA pool elsewhere).
     USE_PALLAS: bool = True
+    # Double-buffered device infeed (data/prefetch.py; SURVEY.md §3.3
+    # infeed row): how many batches ahead a daemon thread runs the host
+    # parse + host->device transfer. 2 = classic double buffering
+    # (default); 0 = synchronous transfers in the step loop (the
+    # round-3 behavior, kept for A/B measurement).
+    INFEED_PREFETCH: int = 2
 
     # ---- encoder architecture: "bag" (reference parity) or
     # "transformer" (set transformer over the contexts,
@@ -135,7 +149,12 @@ class Config:
     export_code_vectors: bool = False       # --export_code_vectors
     save_w2v: Optional[str] = None          # --save_w2v <path>
     save_t2v: Optional[str] = None          # --save_t2v <path>
-    DL_FRAMEWORK: str = "jax"               # --framework (reference: tensorflow|keras)
+    # --framework: the reference selects between its two implementations
+    # (tensorflow|keras) here. This framework has exactly one
+    # implementation (JAX/TPU), so the reference's values are accepted as
+    # ALIASES of it — verify() logs a notice so a ported train.sh is
+    # never silently ambiguous about what ran.
+    DL_FRAMEWORK: str = "jax"
     VERBOSE_MODE: int = 1
 
     # ---- logging ----
@@ -259,7 +278,20 @@ class Config:
         p.add_argument("--epochs", dest="epochs", type=int, default=None)
         p.add_argument("--lr", dest="lr", type=float, default=None)
         p.add_argument("--lr_schedule", dest="lr_schedule", default=None,
-                       choices=["constant", "cosine", "linear"])
+                       choices=["constant", "cosine", "linear",
+                                "warmup_cosine"])
+        p.add_argument("--warmup_steps", dest="warmup_steps", type=int,
+                       default=None,
+                       help="warmup_cosine warmup length "
+                            "(0 = auto, 5%% of total steps)")
+        p.add_argument("--trust_ratio", dest="trust_ratio",
+                       action="store_true",
+                       help="LAMB-style per-array trust-ratio rescale "
+                            "(large-global-batch recipe)")
+        p.add_argument("--infeed_prefetch", dest="infeed_prefetch",
+                       type=int, default=None,
+                       help="batches of host->device transfer to run "
+                            "ahead of the step loop (0 = synchronous)")
         p.add_argument("--sampled_softmax", dest="sampled_softmax",
                        action="store_true")
         p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
@@ -365,6 +397,12 @@ class Config:
             cfg.LEARNING_RATE = ns.lr
         if ns.lr_schedule is not None:
             cfg.LR_SCHEDULE = ns.lr_schedule
+        if ns.warmup_steps is not None:
+            cfg.LR_WARMUP_STEPS = ns.warmup_steps
+        if ns.trust_ratio:
+            cfg.TRUST_RATIO = True
+        if ns.infeed_prefetch is not None:
+            cfg.INFEED_PREFETCH = ns.infeed_prefetch
         if ns.sampled_softmax:
             cfg.USE_SAMPLED_SOFTMAX = True
         if ns.num_sampled is not None:
@@ -434,6 +472,17 @@ class Config:
 
     def verify(self) -> None:
         """Validate flag combinations (reference `Config.verify`)."""
+        if self.DL_FRAMEWORK not in ("jax", "tensorflow", "keras"):
+            raise ValueError(
+                f"--framework {self.DL_FRAMEWORK!r} unknown (expected "
+                "jax, or the reference aliases tensorflow/keras).")
+        if self.DL_FRAMEWORK != "jax":
+            # reference CLI compatibility: both of the reference's
+            # framework choices map onto the one JAX/TPU implementation
+            self.log(f"--framework {self.DL_FRAMEWORK}: running the "
+                     "JAX/TPU implementation (this framework's only "
+                     "backend; the flag is accepted as an alias for "
+                     "reference train.sh compatibility)")
         if not (self.is_training or self.is_loading):
             raise ValueError(
                 "Must train (--data) or load a trained model (--load).")
@@ -458,6 +507,20 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
                 "the adam embedding optimizer.")
+        if self.LR_WARMUP_STEPS < 0:
+            raise ValueError("--warmup_steps must be >= 0.")
+        if self.INFEED_PREFETCH < 0:
+            raise ValueError("--infeed_prefetch must be >= 0.")
+        if self.LR_WARMUP_STEPS > 0 and self.LR_SCHEDULE != "warmup_cosine":
+            raise ValueError(
+                "--warmup_steps applies only to "
+                "--lr_schedule warmup_cosine (other schedules have no "
+                "warmup phase and would silently ignore it).")
+        if self.TRUST_RATIO and self.SPARSE_EMBEDDING_UPDATES:
+            raise ValueError(
+                "--trust_ratio is not supported with "
+                "SPARSE_EMBEDDING_UPDATES (the sparse row-update kernel "
+                "bypasses the optax chain for the tables).")
         if self.SPARSE_EMBEDDING_UPDATES and self.LR_SCHEDULE != "constant":
             # the sparse row-update kernel applies a constant LR; a
             # schedule would be silently ignored

@@ -1,0 +1,122 @@
+"""Double-buffered device infeed (SURVEY.md §3.3 infeed row:
+"fixed-shape int32 [B,200]x3 + f32 mask, double buffered").
+
+The reference's tf.data pipeline prefetches to the GPU; the TPU
+equivalent here is a daemon thread that runs the host side of the next
+`depth` batches — `.c2v`/binary parsing, padding, and the
+host->device `device_put`/`make_array_from_process_local_data` calls —
+while the chip executes the current step. jax transfers are themselves
+asynchronous, so by the time the train loop pops batch k+1 from the
+queue its bytes are already streaming into HBM; the loop never blocks
+on the host between steps (VERDICT r3 item 2: the round-3 loop
+transferred synchronously inside the step loop, idling the chip on
+every host->device copy).
+
+Default depth 2 = classic double buffering: one batch on the chip, one
+in flight. Deeper pipelines buy nothing here (the reader's measured
+27x headroom means the producer is never the bottleneck) and cost host
+RAM at B=8192 shapes.
+
+Multi-host note: each process prefetches its OWN reader shard in
+deterministic reader order, and `make_array_from_process_local_data`
+is per-process local work, so threading it does not reorder anything
+across hosts.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Tuple
+
+_SENTINEL = object()
+
+
+class DevicePrefetcher:
+    """Iterate `(put_fn(batch), batch)` pairs with the put_fn work done
+    up to `depth` batches ahead on a daemon thread.
+
+    put_fn is the host->device transfer (e.g. jax_model._device_batch);
+    the original host batch rides along because the consumers also need
+    host-side fields (num_valid_examples, target_strings).
+
+    Exceptions in the producer thread surface in the consumer at the
+    position they occurred (not silently truncating the epoch).
+    """
+
+    def __init__(self, batches: Iterable, put_fn: Callable,
+                 depth: int = 2):
+        assert depth >= 1
+        self._batches = batches
+        self._put_fn = put_fn
+        self._depth = depth
+
+    # -- consumer (each __iter__ = one epoch: fresh queue + thread, so
+    # the same prefetcher can wrap a re-iterable reader across epochs) --
+    def __iter__(self) -> Iterator[Tuple]:
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded-wait put so an ABANDONED iteration (consumer loop
+            # exited early — exception in the train step, generator
+            # GC'd) releases the thread and its device-resident batches
+            # instead of pinning them for the process lifetime
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for b in self._batches:
+                    if not put((self._put_fn(b), b)):
+                        return
+            except BaseException as e:  # propagate into the consumer
+                put((_SENTINEL, e))
+                return
+            put((_SENTINEL, None))
+
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        try:
+            while True:
+                dev, host = q.get()
+                if dev is _SENTINEL:
+                    thread.join()
+                    if host is not None:
+                        raise host
+                    return
+                yield dev, host
+        finally:
+            stop.set()
+            while thread.is_alive():  # drain so a blocked put returns
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.05)
+
+
+class _SyncInfeed:
+    """depth=0: synchronous transfer in the caller's loop (the round-3
+    behavior, kept for A/B measurement via --infeed_prefetch 0).
+    Re-iterable like DevicePrefetcher so epoch loops treat both alike."""
+
+    def __init__(self, batches: Iterable, put_fn: Callable):
+        self._batches = batches
+        self._put_fn = put_fn
+
+    def __iter__(self) -> Iterator[Tuple]:
+        for b in self._batches:
+            yield self._put_fn(b), b
+
+
+def prefetch_to_device(batches: Iterable, put_fn: Callable,
+                       depth: int = 2) -> Iterable[Tuple]:
+    if depth <= 0:
+        return _SyncInfeed(batches, put_fn)
+    return DevicePrefetcher(batches, put_fn, depth)

@@ -72,6 +72,14 @@ class Code2VecModel(Code2VecModelBase):
             # adafactor template would fail orbax structure matching
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
+            # trust_ratio changes opt_state structure exactly like the
+            # optimizer choice does; pre-round-4 checkpoints never had it
+            cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
+            # warmup length is part of the schedule the run was trained
+            # with — a resume must follow the SAME LR trajectory, not
+            # re-derive an auto length from the new horizon
+            cfg.LR_WARMUP_STEPS = manifest.get("lr_warmup_steps",
+                                               cfg.LR_WARMUP_STEPS)
             from code2vec_tpu.training.optimizers import (
                 resolve_checkpoint_schedule)
             cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
@@ -235,10 +243,15 @@ class Code2VecModel(Code2VecModelBase):
         scalars = ScalarWriter(cfg.TENSORBOARD_DIR
                                if jax.process_index() == 0 else None)
         steps_into_training = 0
+        # Double-buffered infeed (SURVEY.md §3.3): host parse +
+        # host->device transfer of batch k+1 overlap step k on a daemon
+        # thread; the loop below never blocks on the host between steps.
+        from code2vec_tpu.data.prefetch import prefetch_to_device
+        infeed = prefetch_to_device(reader, self._device_batch,
+                                    cfg.INFEED_PREFETCH)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for batch in reader:
+            for dev_batch, batch in infeed:
                 profiler.tick(steps_into_training, self.params)
-                dev_batch = self._device_batch(batch)
                 self.rng, step_rng = jax.random.split(self.rng)
                 self.params, self.opt_state, loss = self._train_step(
                     self.params, self.opt_state, dev_batch, step_rng)
@@ -310,8 +323,11 @@ class Code2VecModel(Code2VecModelBase):
             num_host_shards=jax.process_count() if multi else 1)
         acc = MetricAccumulator(
             cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)
-        for batch in reader:
-            dev_batch = self._device_batch(batch, process_local=multi)
+        from code2vec_tpu.data.prefetch import prefetch_to_device
+        infeed = prefetch_to_device(
+            reader, lambda b: self._device_batch(b, process_local=multi),
+            cfg.INFEED_PREFETCH)
+        for dev_batch, batch in infeed:
             loss_sum, topk_ids, _ = self._eval_step(self.params, dev_batch)
             nv = batch.num_valid_examples
             names = (batch.target_strings[:nv] if batch.target_strings
@@ -402,10 +418,12 @@ class Code2VecModel(Code2VecModelBase):
                  "sparse_embedding_updates":
                      self.config.SPARSE_EMBEDDING_UPDATES,
                  "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
+                 "trust_ratio": self.config.TRUST_RATIO,
                  # always the EFFECTIVE schedule: for loaded models the
                  # manifest override already set cfg.LR_SCHEDULE to what
                  # the saved opt_state structure carries
                  "lr_schedule": self.config.LR_SCHEDULE,
+                 "lr_warmup_steps": self.config.LR_WARMUP_STEPS,
                  # provenance only (no structural effect on restore)
                  "adv_rename_prob": self.config.ADV_RENAME_PROB}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
@@ -438,9 +456,12 @@ class Code2VecModel(Code2VecModelBase):
         encode_step = make_encode_step(self.dims,
                                        compute_dtype=self.compute_dtype,
                                        mesh=self.mesh)
+        from code2vec_tpu.data.prefetch import prefetch_to_device
+        infeed = prefetch_to_device(
+            reader, lambda b: self._device_batch(b, process_local=False),
+            cfg.INFEED_PREFETCH)
         with open(dest_path, "w", encoding="utf-8") as f:
-            for batch in reader:
-                dev_batch = self._device_batch(batch, process_local=False)
+            for dev_batch, batch in infeed:
                 code = encode_step(self.params, dev_batch)
                 code = fetch_global(code)[:batch.num_valid_examples]
                 for row in code:

@@ -57,5 +57,6 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
         else:
             total_steps = 1
     return make_optimizer(
-        make_lr(cfg.LEARNING_RATE, schedule, total_steps),
-        cfg.EMBEDDING_OPTIMIZER)
+        make_lr(cfg.LEARNING_RATE, schedule, total_steps,
+                warmup_steps=cfg.LR_WARMUP_STEPS),
+        cfg.EMBEDDING_OPTIMIZER, trust_ratio=cfg.TRUST_RATIO)

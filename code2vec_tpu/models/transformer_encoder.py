@@ -67,7 +67,7 @@ def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
 
 def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
          log_mask: jax.Array, heads: int,
-         ring_mesh=None) -> jax.Array:
+         ring_mesh=None, use_pallas: bool = False) -> jax.Array:
     B, C, D = x.shape
     hd = D // heads
     proj = x @ qkv.astype(x.dtype)                     # [B, C, 3D]
@@ -80,6 +80,11 @@ def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
     if ring_mesh is not None:
         from code2vec_tpu.ops.ring_attention import ring_attention
         ctx = ring_attention(q, k, v, log_mask, ring_mesh)
+    elif use_pallas:
+        # fused fwd+bwd kernels: no [B, H, C, C] tensor in HBM either
+        # direction (ops/xf_attention.py)
+        from code2vec_tpu.ops.xf_attention import fused_mha
+        ctx = fused_mha(q, k, v, log_mask)
     else:
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
         logits = logits / jnp.sqrt(float(hd)) \
@@ -101,16 +106,19 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
                        use_pallas: bool = False
                        ) -> Tuple[jax.Array, jax.Array]:
     """Same contract as encoder.encode: returns (code [B, D] in compute
-    dtype, pool attention [B, C] f32). `use_pallas` accepted for
-    interface parity (the layers are MXU matmuls XLA already fuses).
-    With dims.ring_attention and a mesh whose 'ctx' axis is > 1, the
-    self-attention runs as ring attention (K/V rotate via ppermute,
-    O(C/s) per-device memory) instead of relying on XLA's all-gather."""
-    del use_pallas
+    dtype, pool attention [B, C] f32). With `use_pallas`, the
+    self-attention runs as the fused Pallas kernel pair
+    (ops/xf_attention.py — no [B, H, C, C] HBM materialization in
+    either direction). With dims.ring_attention and a mesh whose 'ctx'
+    axis is > 1, it runs as ring attention instead (K/V rotate via
+    ppermute, O(C/s) per-device memory) — the ring path wins over the
+    kernel because sharded-C blocks are small enough for XLA."""
     from code2vec_tpu.parallel.mesh import CONTEXT_AXIS
     ring_mesh = (mesh if (dims.ring_attention and mesh is not None
                           and dict(mesh.shape).get(CONTEXT_AXIS, 1) > 1)
                  else None)
+    if ring_mesh is not None:
+        use_pallas = False
     xf = params["xf"]
     emb = jnp.concatenate([
         jnp.take(params["token_emb"], source_ids, axis=0),
@@ -131,7 +139,8 @@ def encode_transformer(params: Dict, source_ids: jax.Array,
     def layer_fn(x, layer):
         h = _rms_norm(x, layer["ln1_scale"])
         x = x + _mha(h, layer["qkv"], layer["out"], log_mask,
-                     dims.xf_heads, ring_mesh=ring_mesh)
+                     dims.xf_heads, ring_mesh=ring_mesh,
+                     use_pallas=use_pallas)
         h = _rms_norm(x, layer["ln2_scale"])
         h = jax.nn.gelu(h @ layer["mlp_up"].astype(compute_dtype))
         return x + h @ layer["mlp_down"].astype(compute_dtype)

@@ -64,6 +64,9 @@ class VarMisuseModel:
             # current adafactor default — see jax_model.py
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
+            cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
+            cfg.LR_WARMUP_STEPS = manifest.get("lr_warmup_steps",
+                                               cfg.LR_WARMUP_STEPS)
             from code2vec_tpu.training.optimizers import (
                 resolve_checkpoint_schedule)
             cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
@@ -144,11 +147,13 @@ class VarMisuseModel:
         profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
                                 cfg.PROFILE_STEPS, self.log)
         steps_into_training = 0
+        from code2vec_tpu.data.prefetch import prefetch_to_device
+        infeed = prefetch_to_device(reader, self._device_batch,
+                                    cfg.INFEED_PREFETCH)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for batch in reader:
+            for dev_batch, batch in infeed:
                 profiler.tick(steps_into_training, self.params)
                 steps_into_training += 1
-                dev_batch = self._device_batch(batch)
                 self.rng, k = jax.random.split(self.rng)
                 self.params, self.opt_state, loss = self._train_step(
                     self.params, self.opt_state, dev_batch, k)
@@ -183,8 +188,11 @@ class VarMisuseModel:
                               num_host_shards=jax.process_count()
                               if multi else 1)
         loss_sum = correct = total = 0.0
-        for batch in reader:
-            dev_batch = self._device_batch(batch, process_local=multi)
+        from code2vec_tpu.data.prefetch import prefetch_to_device
+        infeed = prefetch_to_device(
+            reader, lambda b: self._device_batch(b, process_local=multi),
+            cfg.INFEED_PREFETCH)
+        for dev_batch, batch in infeed:
             ls, cs, _pred = self._eval_step(self.params, dev_batch)
             loss_sum += float(ls)
             correct += float(cs)
@@ -230,7 +238,9 @@ class VarMisuseModel:
         extra = {"head": "varmisuse",
                  "max_candidates": self.config.MAX_CANDIDATES,
                  "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
-                 "lr_schedule": self.config.LR_SCHEDULE}
+                 "trust_ratio": self.config.TRUST_RATIO,
+                 "lr_schedule": self.config.LR_SCHEDULE,
+                 "lr_warmup_steps": self.config.LR_WARMUP_STEPS}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

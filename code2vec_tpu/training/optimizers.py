@@ -73,7 +73,7 @@ def scale_by_adam_f32_moments(b1: float = 0.9, b2: float = 0.999,
 
 
 def make_lr(learning_rate: float, schedule: str = "constant",
-            total_steps: int = 0):
+            total_steps: int = 0, warmup_steps: int = 0):
     """Returns a float or an optax schedule.
 
     The reference trains at constant LR (TF AdamOptimizer default —
@@ -85,6 +85,13 @@ def make_lr(learning_rate: float, schedule: str = "constant",
     in training (at lr=5e-4 the decay vanishes, Adam nu stays flat so
     it is not an effective-LR spike). A decaying schedule removes the
     pathology without relying on bf16 rounding noise.
+
+    "warmup_cosine" (round 4, the large-global-batch recipe): linear
+    0→peak over `warmup_steps` (default 5% of total_steps), then cosine
+    to 10% of peak. At B≥8192 the first steps take scaled-LR updates on
+    cold Adam/Adafactor second moments — warmup is the standard cure
+    (Goyal et al. 2017), and the large-batch study (BASELINE.md round 4)
+    measures what it buys here.
     """
     if schedule == "constant":
         return learning_rate
@@ -95,6 +102,18 @@ def make_lr(learning_rate: float, schedule: str = "constant",
     if schedule == "linear":
         return optax.linear_schedule(learning_rate, learning_rate * 0.1,
                                      total_steps)
+    if schedule == "warmup_cosine":
+        w = warmup_steps if warmup_steps > 0 else max(1,
+                                                      total_steps // 20)
+        w = min(w, max(1, total_steps - 1))
+        # optax cosine-decays over (decay_steps - warmup_steps), which
+        # must stay positive — eval/predict-only loads build the
+        # schedule with horizon 1 just for opt_state STRUCTURE
+        # (models/setup.build_optimizer), so clamp rather than assert
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=learning_rate, warmup_steps=w,
+            decay_steps=max(total_steps, w + 1),
+            end_value=0.1 * learning_rate)
     raise ValueError(f"unknown lr schedule {schedule!r}")
 
 
@@ -124,11 +143,27 @@ def resolve_checkpoint_schedule(requested: str, manifest: dict,
 
 
 def make_optimizer(learning_rate,
-                   embedding_optimizer: str = "adafactor"
+                   embedding_optimizer: str = "adafactor",
+                   trust_ratio: bool = False
                    ) -> optax.GradientTransformation:
-    """`learning_rate` is a float or an optax schedule (see make_lr)."""
+    """`learning_rate` is a float or an optax schedule (see make_lr).
+
+    `trust_ratio=True` (round 4, the large-global-batch recipe) inserts
+    a LAMB-style per-array trust-ratio rescale (You et al. 2020:
+    update *= ||param|| / ||update||, guarded to 1 when either norm is
+    0) between the preconditioner and the LR scaling, on every branch.
+    Per-array granularity means each vocab TABLE is one trust group —
+    the same granularity LAMB uses per layer. Changes the opt_state
+    STRUCTURE, so it is recorded in the checkpoint manifest like
+    embedding_optimizer.
+    """
     if embedding_optimizer == "adam":
+        if not trust_ratio:
+            return optax.chain(
+                scale_by_adam_f32_moments(),
+                optax.scale_by_learning_rate(learning_rate))
         return optax.chain(scale_by_adam_f32_moments(),
+                           optax.scale_by_trust_ratio(),
                            optax.scale_by_learning_rate(learning_rate))
     if embedding_optimizer == "adafactor":
         # label by key so extra head params (e.g. vm_pointer) route to
@@ -137,12 +172,28 @@ def make_optimizer(learning_rate,
             return {k: ("table" if k in TABLE_PARAMS else "small")
                     for k in params}
 
-        return optax.multi_transform(
-            {"table": optax.adafactor(
+        if not trust_ratio:
+            table_tx = optax.adafactor(
                 learning_rate, multiply_by_parameter_scale=False,
-                momentum=None),
-             "small": optax.adam(learning_rate)},
-            labels)
+                momentum=None)
+            small_tx = optax.adam(learning_rate)
+        else:
+            # optax.adafactor(lr, multiply_by_parameter_scale=False,
+            # momentum=None) == factored_rms + block-rms clip + lr;
+            # rebuilt here explicitly so the trust ratio lands between
+            # the clip and the LR (after the LR it would cancel the
+            # schedule — ||update|| already contains lr).
+            table_tx = optax.chain(
+                optax.scale_by_factored_rms(),
+                optax.clip_by_block_rms(1.0),
+                optax.scale_by_trust_ratio(),
+                optax.scale_by_learning_rate(learning_rate))
+            small_tx = optax.chain(
+                optax.scale_by_adam(),
+                optax.scale_by_trust_ratio(),
+                optax.scale_by_learning_rate(learning_rate))
+        return optax.multi_transform({"table": table_tx,
+                                      "small": small_tx}, labels)
     raise ValueError(
         f"unknown embedding_optimizer {embedding_optimizer!r} "
         "(expected 'adam' or 'adafactor')")

@@ -78,6 +78,22 @@ def test_untargeted_attack_flips_predictions(trained):
             assert r.final_prediction != r.original_prediction
 
 
+def test_batch_attack_rejects_unattackable_method(trained):
+    """A method with zero attackable tokens gets a clear ValueError from
+    attack_batch, not a bare IndexError (ADVICE r3): external callers
+    that skip robustness.py's filter see the precondition by name."""
+    import pytest
+
+    _, model, prefix = trained
+    attack = _attack_for(model, max_iters=2)
+    _, methods = _test_methods(model, prefix, 2)
+    m = methods[0]
+    # fully-padded method: no valid slots -> no attackable tokens
+    dead = (m[0], m[1], m[2], np.zeros_like(m[3]))
+    with pytest.raises(ValueError, match="no attackable tokens"):
+        attack.attack_batch(model.params, [methods[1], dead])
+
+
 def test_batch_attack_matches_serial(trained):
     """attack_batch is an optimization, not a different attack: same
     success flags, renames, and final predictions as the serial driver

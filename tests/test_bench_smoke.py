@@ -61,3 +61,40 @@ def test_graft_entry_forward_compiles():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     assert out.shape[0] == 256
+
+
+def test_aggregate_projection_collective_model():
+    """The v4-32 projection (tools/aggregate_projection.py) must model
+    DP efficiency from explicit collective traffic, not imply 1.0
+    (VERDICT r3 item 5)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "aggregate_projection",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools",
+            "aggregate_projection.py"))
+    ap = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ap)
+
+    m = ap.collective_model(per_chip_batch=1024, step_ms=26.0)
+    # both shipped meshes are itemized with strictly positive comm
+    for mesh in ("pure_dp16_replicated", "data4xmodel4_rowsharded"):
+        assert 0 < m[mesh]["dp_efficiency"] < 1
+        assert m[mesh]["comm_ms"] > 0
+    # replicated-table DP moves the full dense grads every step; the
+    # row-sharded mesh must beat it (that is why the TP axis exists)
+    dp = m["pure_dp16_replicated"]
+    tp = m["data4xmodel4_rowsharded"]
+    assert tp["dp_efficiency"] > dp["dp_efficiency"]
+    assert m["recommended_mesh"] == "data4xmodel4_rowsharded"
+    # bytes sanity: replicated allreduce carries the three bf16 tables
+    expected = 2 * (ap.VT * ap.E + ap.VP * ap.E + ap.VY * ap.D3)
+    assert abs(dp["allreduce_bytes_per_step"] - expected) < 1e7
+    # the formula itself rides in the output (checkable prose)
+    assert "2*(N-1)/N" in m["formula"]
+    # a zero-comm step would be efficiency 1; the formula must be
+    # monotone in step time (longer steps amortize the same traffic)
+    m_slow = ap.collective_model(per_chip_batch=1024, step_ms=100.0)
+    assert (m_slow["modeled_efficiency"] > m["modeled_efficiency"])

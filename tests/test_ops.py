@@ -123,6 +123,15 @@ def test_make_lr_and_horizon_helpers():
     assert abs(float(sched(500)) - 1e-4) < 1e-9
     lin = make_lr(2e-3, "linear", 10)
     assert abs(float(lin(10)) - 2e-4) < 1e-9
+    # warmup_cosine: 0 at step 0, peak at the end of warmup, 10% floor
+    wc = make_lr(1e-3, "warmup_cosine", 100, warmup_steps=10)
+    assert abs(float(wc(0))) < 1e-9
+    assert abs(float(wc(10)) - 1e-3) < 1e-9
+    assert abs(float(wc(100)) - 1e-4) < 1e-9
+    # auto warmup = 5% of the horizon
+    wc_auto = make_lr(1e-3, "warmup_cosine", 200)
+    assert abs(float(wc_auto(10)) - 1e-3) < 1e-9
+    assert float(wc_auto(5)) < 1e-3
 
     # horizon: per-host ceil-div batches times epochs, plus resume offset
     assert schedule_total_steps(100, 32, 2) == 8  # ceil(100/32)=4 *2
@@ -137,3 +146,37 @@ def test_make_lr_and_horizon_helpers():
     assert resolve_checkpoint_schedule(
         "cosine", {"lr_schedule": "cosine"}, msgs.append) == "cosine"
     assert not msgs
+
+
+def test_trust_ratio_rescales_per_array():
+    """make_optimizer(trust_ratio=True): the LAMB-style rescale makes
+    every per-array update land at lr * ||param|| / ||normalized
+    update|| — so two arrays with very different norms get different
+    effective step sizes, unlike plain adam whose normalized update
+    magnitude is norm-independent."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.training.optimizers import make_optimizer
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"token_emb": 10.0 * jax.random.normal(k1, (16, 8)),
+              "transform": 0.1 * jax.random.normal(k2, (8, 8))}
+    grads = jax.tree_util.tree_map(
+        lambda p: jax.random.normal(jax.random.PRNGKey(7), p.shape), params)
+
+    for eopt in ("adam", "adafactor"):
+        opt_plain = make_optimizer(1e-3, eopt)
+        opt_tr = make_optimizer(1e-3, eopt, trust_ratio=True)
+        up_p, _ = opt_plain.update(grads, opt_plain.init(params), params)
+        up_t, _ = opt_tr.update(grads, opt_tr.init(params), params)
+        norm = lambda x: float(jnp.linalg.norm(x))
+        # the big-norm table takes a LARGER step under trust ratio, the
+        # small-norm matrix a smaller one: ratio ||p||/||u|| straddles 1
+        assert norm(up_t["token_emb"]) > norm(up_p["token_emb"])
+        assert norm(up_t["transform"]) < norm(up_p["transform"])
+        # trust-ratio updates scale exactly with ||p|| per array
+        ratio = norm(up_t["token_emb"]) / norm(params["token_emb"])
+        ratio2 = norm(up_t["transform"]) / norm(params["transform"])
+        assert abs(ratio - 1e-3) / 1e-3 < 0.05, (eopt, ratio)
+        assert abs(ratio2 - 1e-3) / 1e-3 < 0.05, (eopt, ratio2)

@@ -74,10 +74,16 @@ def test_import_reference_tf_checkpoint(tmp_path):
          "--dict", prefix + ".dict.c2v", "--save", out_dir,
          "--max_contexts", "16",
          "--word_vocab_size", "1000", "--path_vocab_size", "1000",
-         "--target_vocab_size", "1000"],
-        capture_output=True, text=True, timeout=300)
+         "--target_vocab_size", "1000",
+         "--verify_test", prefix + ".test.c2v", "--verify_rows", "16"],
+        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr
     assert "imported TF checkpoint" in r.stdout
+    # the semantic row-order check ran (ADVICE r3: a shape check alone
+    # cannot catch row misalignment — this can). No warning asserted:
+    # with a 10-word toy vocab, chance-level top1 (~1/8) sits above the
+    # misalignment threshold that real 261K-vocab imports would trip.
+    assert "verify_test (16 rows)" in r.stdout
 
     # the imported checkpoint loads as a released model and serves
     cfg2 = tiny_config(prefix)

@@ -145,6 +145,37 @@ def method_source(rng: random.Random, verb: str, adj: str,
     return "\n".join("  " + ln for ln in lines)
 
 
+REDUNDANT_SUFFIXES = ("Src", "Buf", "Acc")  # one per cue position
+
+
+def method_source_redundant(rng: random.Random, verb: str, adj: str,
+                            noun: str, k_cues: int) -> str:
+    """--redundant_cues mode (VERDICT r4 item 6, the defense positive
+    control): the label is carried by `k_cues` DISTINCT local variables,
+    each individually label-identifying (cue_i = methodName+suffix_i, a
+    distinct vocab token whose subtokens spell the full label), chained
+    so every cue appears in multiple path contexts. Renaming any single
+    variable provably leaves k-1 intact cues — an information-theoretic
+    guarantee the default corpus lacks (there one field token is the
+    only cue, so one rename destroys the label signal and NO defense
+    can win; BASELINE.md round-3 'corpus determinism' analysis)."""
+    mname = verb + cap(adj) + cap(noun) if adj else verb + cap(noun)
+    cues = [mname + REDUNDANT_SUFFIXES[i % len(REDUNDANT_SUFFIXES)]
+            + (str(i // len(REDUNDANT_SUFFIXES)) if
+               i >= len(REDUNDANT_SUFFIXES) else "")
+            for i in range(k_cues)]
+    distract = rng.choice(NOUNS)
+    lines = [f"int {mname}(int x) {{",
+             f"  int {cues[0]} = x + 1;"]
+    for prev, cur in zip(cues, cues[1:]):
+        lines.append(f"  int {cur} = {prev} * 2;")
+    if rng.random() < 0.3:
+        lines.append(f"  int {distract} = x - 1;")
+    lines.append(f"  return {cues[-1]};")
+    lines.append("}")
+    return "\n".join("  " + ln for ln in lines)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", required=True)
@@ -156,6 +187,11 @@ def main() -> None:
                     help="size of a long-tail distractor-name pool; "
                          "0 (default) keeps the original corpus "
                          "byte-identical")
+    ap.add_argument("--redundant_cues", type=int, default=0,
+                    help="k>=1: every method carries k independent "
+                         "label-identifying locals (defense positive "
+                         "control; see method_source_redundant). "
+                         "0 (default) keeps the original bodies")
     args = ap.parse_args()
     rng = random.Random(args.seed)
     tail_pool = None
@@ -206,9 +242,13 @@ def main() -> None:
             body = []
             fields = set()
             for v, a, n in chosen:
-                fields.add((a + cap(n)) if a else n)
-                body.append(method_source(rng, v, a, n,
-                                          tail_pool=tail_pool))
+                if args.redundant_cues:
+                    body.append(method_source_redundant(
+                        rng, v, a, n, args.redundant_cues))
+                else:
+                    fields.add((a + cap(n)) if a else n)
+                    body.append(method_source(rng, v, a, n,
+                                              tail_pool=tail_pool))
             field_decls = "\n".join(f"  int {f};" for f in sorted(fields))
             cls = (f"class C{split.capitalize()}{file_idx} {{\n"
                    f"{field_decls}\n" + "\n".join(body) + "\n}\n")

@@ -23,6 +23,19 @@ located by NAME SUBSTRING, tolerant of scope prefixes; every mapped
 array is shape-checked against the vocab sizes derived from --dict, and
 a mismatch is a loud error naming both shapes — run with the same vocab
 size flags the model was trained with.
+
+ROW-ORDER ASSUMPTION (shape checks cannot catch this): embedding row i
+of each imported table is taken to mean the word that
+`Vocab.create_from_freq_dict` assigns index i — special rows first
+(PAD=0, OOV=1), then count-descending with stable ties, built from the
+SAME --dict file the reference model was trained with. That matches the
+reference's vocab construction as surveyed [M], but a reference fork
+with a different special-row layout or tie order would import cleanly
+with every row silently misaligned. That is why --verify_test exists:
+pass any .c2v file with ground-truth labels drawn from the model's
+training distribution and the importer re-predicts it with the imported
+weights — a row misalignment collapses top-1 to ~0, so a sane score is
+positive evidence the ordering assumption held.
 """
 
 from __future__ import annotations
@@ -78,6 +91,13 @@ def main() -> int:
     ap.add_argument("--word_vocab_size", type=int, default=1_301_136)
     ap.add_argument("--path_vocab_size", type=int, default=911_417)
     ap.add_argument("--target_vocab_size", type=int, default=261_245)
+    ap.add_argument("--verify_test", default=None,
+                    help="a .c2v file with true labels; after import, "
+                         "re-predict up to --verify_rows of it with the "
+                         "imported weights and print top-k/F1 — the "
+                         "semantic check for the row-order assumption "
+                         "(see module docstring)")
+    ap.add_argument("--verify_rows", type=int, default=256)
     a = ap.parse_args()
 
     import numpy as np
@@ -156,6 +176,42 @@ def main() -> int:
         }, max_to_keep=1)
     print(f"imported TF checkpoint -> {a.save} (released; "
           f"`python code2vec.py --load {a.save} --predict` to serve)")
+
+    if a.verify_test:
+        import tempfile
+
+        from code2vec_tpu.config import Config
+        from code2vec_tpu.models.jax_model import Code2VecModel
+
+        with open(a.verify_test, encoding="utf-8") as f:
+            lines = [ln for _, ln in zip(range(a.verify_rows), f)
+                     if ln.strip()]
+        if not lines:
+            raise SystemExit(
+                f"error: --verify_test {a.verify_test} has no rows "
+                "(the import above succeeded; re-run the check with a "
+                "non-empty .c2v file)")
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".c2v", delete=False) as tmp:
+            tmp.writelines(lines)
+            sample = tmp.name
+        try:
+            cfg = Config(MAX_CONTEXTS=a.max_contexts,
+                         TEST_BATCH_SIZE=min(256, len(lines)))
+            cfg.load_path = a.save
+            cfg.test_data_path = sample
+            res = Code2VecModel(cfg).evaluate()
+            print(f"verify_test ({len(lines)} rows): "
+                  f"top1 {res.topk_acc[0]:.4f}, "
+                  f"subtoken F1 {res.subtoken_f1:.4f}")
+            if res.topk_acc[0] < 0.01:
+                print("WARNING: top-1 is ~0 — the imported rows are "
+                      "likely MISALIGNED with the vocab (wrong --dict, "
+                      "wrong vocab-size flags, or a fork with a "
+                      "different vocab ordering). Do not serve this "
+                      "checkpoint.")
+        finally:
+            os.unlink(sample)
     return 0
 
 

@@ -42,7 +42,9 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
                 num_sampled: int, seed: int, lr: float = 1e-3,
                 lr_schedule: str = "constant",
                 max_contexts: int = 200,
-                save_path: str = None) -> dict:
+                save_path: str = None,
+                warmup_steps: int = 0,
+                trust_ratio: bool = False) -> dict:
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
 
@@ -59,6 +61,8 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         NUM_BATCHES_TO_LOG_PROGRESS=100,
         LEARNING_RATE=lr,
         LR_SCHEDULE=lr_schedule,
+        LR_WARMUP_STEPS=warmup_steps,
+        TRUST_RATIO=trust_ratio,
         SEED=seed,
         USE_SAMPLED_SOFTMAX=use_sampled,
         NUM_SAMPLED_CLASSES=num_sampled,
@@ -68,6 +72,8 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
     )
     cfg.train_data_path = data
     cfg.test_data_path = data + ".val.c2v"
+    cfg.verify()  # e.g. reject --warmup_steps with a non-warmup
+    # schedule instead of recording a misleading combination
     model = Code2VecModel(cfg)
     t0 = time.time()
     model.train()
@@ -88,6 +94,8 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         "batch": batch,
         "lr": lr,
         "lr_schedule": lr_schedule,
+        "warmup_steps": warmup_steps,
+        "trust_ratio": trust_ratio,
         "max_contexts": max_contexts,
         "steps": model.step_num,
         "train_seconds": round(train_s, 1),
@@ -114,7 +122,12 @@ def main() -> None:
                          "neutrality)")
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--lr_schedule", default="constant",
-                    choices=["constant", "cosine", "linear"])
+                    choices=["constant", "cosine", "linear",
+                             "warmup_cosine"])
+    ap.add_argument("--warmup_steps", type=int, default=0,
+                    help="warmup_cosine warmup length (0 = auto 5%%)")
+    ap.add_argument("--trust_ratio", action="store_true",
+                    help="LAMB-style per-array trust ratio")
     ap.add_argument("--num_sampled", type=int, default=1024)
     ap.add_argument("--max_contexts", type=int, default=200,
                     help="match the dataset's binarized width (200 for "
@@ -135,7 +148,9 @@ def main() -> None:
                         lr_schedule=args.lr_schedule,
                         max_contexts=args.max_contexts,
                         save_path=(args.save + "." + name.strip()
-                                   if args.save else None))
+                                   if args.save else None),
+                        warmup_steps=args.warmup_steps,
+                        trust_ratio=args.trust_ratio)
         results.append(r)
         if args.out:
             with open(args.out, "a") as f:
