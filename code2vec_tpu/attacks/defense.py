@@ -41,7 +41,8 @@ def legal_token_mask(token_vocab: Vocab, dims: ModelDims) -> np.ndarray:
     return mask
 
 
-def make_rename_augment(legal: np.ndarray, prob: float) -> Callable:
+def make_rename_augment(legal: np.ndarray, prob: float,
+                        mode: str = "uniform") -> Callable:
     """Returns jit-safe `augment(batch, rng) -> batch`.
 
     Per example: pick one valid context slot whose source token is a
@@ -49,11 +50,27 @@ def make_rename_augment(legal: np.ndarray, prob: float) -> Callable:
     never OOV/PAD/literal tokens, whose occurrences span many distinct
     source identifiers and would over-perturb), then with probability
     `prob` replace ALL occurrences of that token in the example's
-    src/dst slots with one uniformly-drawn legal token. Collisions with
-    tokens the example already uses are allowed — augmentation is noise
-    injection, not a validity-checked attack. Examples with no legal
-    slot are left unchanged. `legal` is the bool [padded_rows] mask from
-    legal_token_mask."""
+    src/dst slots with a replacement token. Collisions with tokens the
+    example already uses are allowed — augmentation is noise injection,
+    not a validity-checked attack. Examples with no legal slot are left
+    unchanged. `legal` is the bool [padded_rows] mask from
+    legal_token_mask.
+
+    `mode` selects the replacement distribution:
+
+    - "uniform": one uniformly-drawn legal token (the round-3 defense).
+      Matches the attack's manipulation SURFACE but not its choice: on
+      a 150K vocab the draw almost never lands on a token that argues
+      for a different class, so the model never trains against
+      conflicting evidence.
+    - "batch": the token another example in the batch selected (a
+      batch-index roll) — typically a DIFFERENT class's name-bearing
+      identifier. This simulates what the gradient attack actually
+      does (inject a wrong-class cue) and is what teaches the model to
+      weigh cues against each other instead of trusting any single one
+      (round-4 defense positive control; BASELINE.md).
+    """
+    assert mode in ("uniform", "batch"), mode
     legal_mask = jnp.asarray(legal)
     legal = jnp.asarray(np.nonzero(legal)[0].astype(np.int32))
 
@@ -72,7 +89,19 @@ def make_rename_augment(legal: np.ndarray, prob: float) -> Callable:
         slot_logits = jnp.where(eligible, 0.0, -1e9)
         j = jax.random.categorical(r_slot, slot_logits, axis=-1)
         tok = jnp.take_along_axis(all_tok, j[:, None], axis=1)[:, 0]
-        new = legal[jax.random.randint(r_new, (B,), 0, legal.shape[0])]
+        if mode == "batch":
+            # another example's selected variable = usually a
+            # wrong-class cue; roll avoids i->i (shift in [1, B-1]).
+            # Rows whose donor token is illegal (donor had no legal
+            # slot) fall back to a uniform legal draw via `where`.
+            shift = jax.random.randint(r_new, (), 1, max(B, 2))
+            donor = jnp.roll(tok, shift)
+            fallback = legal[jax.random.randint(
+                jax.random.fold_in(r_new, 1), (B,), 0, legal.shape[0])]
+            new = jnp.where(legal_mask[donor], donor, fallback)
+        else:
+            new = legal[jax.random.randint(r_new, (B,), 0,
+                                           legal.shape[0])]
         keep = (jax.random.bernoulli(r_apply, prob, (B,))
                 & legal_mask[tok])  # no-legal-slot rows stay unchanged
         # a non-id sentinel disables the rename where keep is False

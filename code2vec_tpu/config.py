@@ -106,6 +106,12 @@ class Config:
     # (default); 0 = synchronous transfers in the step loop (the
     # round-3 behavior, kept for A/B measurement).
     INFEED_PREFETCH: int = 2
+    # Latency-amortizing chunked infeed (prefetch.py
+    # ChunkedDevicePrefetcher): group this many batches into ONE
+    # host->device transfer and slice on-device. 1 = off (default).
+    # For high-latency links (the tunneled dev platform: ~200 ms per
+    # transfer round trip); single-device only — ignored with a mesh.
+    INFEED_CHUNK: int = 1
 
     # ---- encoder architecture: "bag" (reference parity) or
     # "transformer" (set transformer over the contexts,
@@ -189,6 +195,11 @@ class Config:
     # random legal token (occurrences replaced consistently) inside the
     # jitted train step. 0 disables (reference parity).
     ADV_RENAME_PROB: float = 0.0
+    # Replacement distribution for the defense: "uniform" (random legal
+    # token, round-3 behavior) or "batch" (another example's variable —
+    # simulates the attack's wrong-class cue injection; the measured
+    # positive-control defense, BASELINE.md round 4).
+    ADV_RENAME_MODE: str = "uniform"
 
     def __post_init__(self) -> None:
         if self.TARGET_EMBEDDINGS_SIZE is None:
@@ -292,6 +303,10 @@ class Config:
                        type=int, default=None,
                        help="batches of host->device transfer to run "
                             "ahead of the step loop (0 = synchronous)")
+        p.add_argument("--infeed_chunk", dest="infeed_chunk",
+                       type=int, default=None,
+                       help="batches per host->device transfer "
+                            "(latency amortization; 1 = off)")
         p.add_argument("--sampled_softmax", dest="sampled_softmax",
                        action="store_true")
         p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
@@ -366,6 +381,11 @@ class Config:
                        help="adversarial-training defense: probability "
                             "of randomly renaming one variable per "
                             "training example")
+        p.add_argument("--adv_rename_mode", dest="adv_rename_mode",
+                       default=None, choices=["uniform", "batch"],
+                       help="defense replacement distribution: uniform "
+                            "legal token, or another batch example's "
+                            "variable (wrong-class cue training)")
         p.add_argument("-v", "--verbose", dest="verbose_mode", type=int, default=None)
         return p
 
@@ -403,6 +423,8 @@ class Config:
             cfg.TRUST_RATIO = True
         if ns.infeed_prefetch is not None:
             cfg.INFEED_PREFETCH = ns.infeed_prefetch
+        if ns.infeed_chunk is not None:
+            cfg.INFEED_CHUNK = ns.infeed_chunk
         if ns.sampled_softmax:
             cfg.USE_SAMPLED_SOFTMAX = True
         if ns.num_sampled is not None:
@@ -465,6 +487,8 @@ class Config:
             cfg.ATTACK_ITERS = ns.attack_iters
         if ns.adv_rename_prob is not None:
             cfg.ADV_RENAME_PROB = ns.adv_rename_prob
+        if ns.adv_rename_mode is not None:
+            cfg.ADV_RENAME_MODE = ns.adv_rename_mode
         if ns.verbose_mode is not None:
             cfg.VERBOSE_MODE = ns.verbose_mode
         cfg.verify()
@@ -511,6 +535,15 @@ class Config:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:
             raise ValueError("--infeed_prefetch must be >= 0.")
+        if self.INFEED_CHUNK < 1:
+            raise ValueError("--infeed_chunk must be >= 1.")
+        if self.INFEED_CHUNK > 1 and self.INFEED_PREFETCH == 0:
+            # chunking is inherently threaded (the producer stacks
+            # ahead); silently running a thread under the synchronous
+            # A/B control flag would confound the measurement
+            raise ValueError(
+                "--infeed_chunk > 1 requires --infeed_prefetch >= 1 "
+                "(chunked infeed always uses the producer thread).")
         if self.LR_WARMUP_STEPS > 0 and self.LR_SCHEDULE != "warmup_cosine":
             raise ValueError(
                 "--warmup_steps applies only to "

@@ -75,15 +75,12 @@ class Code2VecModel(Code2VecModelBase):
             # trust_ratio changes opt_state structure exactly like the
             # optimizer choice does; pre-round-4 checkpoints never had it
             cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
-            # warmup length is part of the schedule the run was trained
-            # with — a resume must follow the SAME LR trajectory, not
-            # re-derive an auto length from the new horizon
-            cfg.LR_WARMUP_STEPS = manifest.get("lr_warmup_steps",
-                                               cfg.LR_WARMUP_STEPS)
             from code2vec_tpu.training.optimizers import (
-                resolve_checkpoint_schedule)
+                resolve_checkpoint_schedule, resolve_checkpoint_warmup)
             cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
                 cfg.LR_SCHEDULE, manifest, cfg.log)
+            cfg.LR_WARMUP_STEPS = resolve_checkpoint_warmup(
+                cfg.LR_SCHEDULE, cfg.LR_WARMUP_STEPS, manifest, cfg.log)
         else:
             self.dims = ModelDims(
                 token_vocab_size=self.vocabs.token_vocab.size,
@@ -176,7 +173,7 @@ class Code2VecModel(Code2VecModelBase):
                     legal_token_mask, make_rename_augment)
                 augment_fn = make_rename_augment(
                     legal_token_mask(self.vocabs.token_vocab, self.dims),
-                    cfg.ADV_RENAME_PROB)
+                    cfg.ADV_RENAME_PROB, mode=cfg.ADV_RENAME_MODE)
             self._train_step = make_train_step(
                 self.dims, self.optimizer,
                 use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
@@ -206,20 +203,37 @@ class Code2VecModel(Code2VecModelBase):
             cfg.MAX_PATH_VOCAB_SIZE, cfg.MAX_TARGET_VOCAB_SIZE)
 
     # ---- helpers ----
+    def _host_batch_arrays(self, b: BatchTensors):
+        """The 6 numpy arrays of one batch (pre-transfer form — shared
+        by the per-batch and chunked infeeds)."""
+        weights = np.zeros((b.target_index.shape[0],), dtype=np.float32)
+        weights[:b.num_valid_examples] = 1.0
+        return (b.target_index, b.path_source_token_indices,
+                b.path_indices, b.path_target_token_indices,
+                b.context_valid_mask, weights)
+
     def _device_batch(self, b: BatchTensors, process_local: bool = True):
         """process_local=True for training (each host contributes its own
         shard; global batch scales with host count), False for eval and
         predict (all hosts feed the same batch)."""
-        weights = np.zeros((b.target_index.shape[0],), dtype=np.float32)
-        weights[:b.num_valid_examples] = 1.0
-        arrays = (b.target_index, b.path_source_token_indices,
-                  b.path_indices, b.path_target_token_indices,
-                  b.context_valid_mask, weights)
+        arrays = self._host_batch_arrays(b)
         if self.mesh is not None:
             return shard_batch(self.mesh, arrays,
                                process_local=process_local,
                                shard_contexts=self.shard_contexts)
-        return arrays
+        # materialize on device HERE (async dispatch) — without this
+        # the arrays ride into the jitted step as numpy and the
+        # transfer happens on the MAIN thread at call time, making the
+        # prefetch thread parse-only (round-4 infeed A/B finding)
+        return tuple(jnp.asarray(a) for a in arrays)
+
+    def _train_infeed(self, reader):
+        from code2vec_tpu.data.prefetch import build_train_infeed
+        return build_train_infeed(
+            reader, chunk=self.config.INFEED_CHUNK,
+            depth=self.config.INFEED_PREFETCH, mesh=self.mesh,
+            host_arrays_fn=self._host_batch_arrays,
+            device_batch_fn=self._device_batch, log=self.log)
 
     def _ids_to_words(self, topk_ids: np.ndarray) -> List[List[str]]:
         tv = self.vocabs.target_vocab
@@ -246,9 +260,7 @@ class Code2VecModel(Code2VecModelBase):
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
         # host->device transfer of batch k+1 overlap step k on a daemon
         # thread; the loop below never blocks on the host between steps.
-        from code2vec_tpu.data.prefetch import prefetch_to_device
-        infeed = prefetch_to_device(reader, self._device_batch,
-                                    cfg.INFEED_PREFETCH)
+        infeed = self._train_infeed(reader)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
             for dev_batch, batch in infeed:
                 profiler.tick(steps_into_training, self.params)
@@ -425,7 +437,8 @@ class Code2VecModel(Code2VecModelBase):
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS,
                  # provenance only (no structural effect on restore)
-                 "adv_rename_prob": self.config.ADV_RENAME_PROB}
+                 "adv_rename_prob": self.config.ADV_RENAME_PROB,
+                 "adv_rename_mode": self.config.ADV_RENAME_MODE}
         ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
                              self.dims, extra_manifest=extra,
                              max_to_keep=self.config.MAX_TO_KEEP)

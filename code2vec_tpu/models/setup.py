@@ -43,7 +43,8 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
       yields the right opt_state STRUCTURE.
     """
     from code2vec_tpu.training.optimizers import (make_lr, make_optimizer,
-                                                  schedule_total_steps)
+                                                  schedule_total_steps,
+                                                  warmup_length)
     schedule = cfg.LR_SCHEDULE
     total_steps = 0
     if schedule != "constant":
@@ -54,6 +55,12 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
                 num_hosts=jax.process_count(),
                 restored_step=(int(manifest.get("step", 0))
                                if cfg.is_loading and manifest else 0))
+            if schedule == "warmup_cosine":
+                # resolve auto-warmup (0) to its effective length NOW so
+                # the manifest records it and a resume follows the SAME
+                # trajectory instead of re-deriving 5% of a new horizon
+                cfg.LR_WARMUP_STEPS = warmup_length(total_steps,
+                                                    cfg.LR_WARMUP_STEPS)
         else:
             total_steps = 1
     return make_optimizer(

@@ -65,12 +65,12 @@ class VarMisuseModel:
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
             cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
-            cfg.LR_WARMUP_STEPS = manifest.get("lr_warmup_steps",
-                                               cfg.LR_WARMUP_STEPS)
             from code2vec_tpu.training.optimizers import (
-                resolve_checkpoint_schedule)
+                resolve_checkpoint_schedule, resolve_checkpoint_warmup)
             cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
                 cfg.LR_SCHEDULE, manifest, cfg.log)
+            cfg.LR_WARMUP_STEPS = resolve_checkpoint_warmup(
+                cfg.LR_SCHEDULE, cfg.LR_WARMUP_STEPS, manifest, cfg.log)
             self.vocabs = ckpt.load_vocabs(cfg.load_path)
         else:
             assert cfg.train_data_path, "varmisuse needs --data or --load"
@@ -122,17 +122,23 @@ class VarMisuseModel:
         assert p
         return f"{p}.{split}.vm.c2v"
 
-    def _device_batch(self, b, process_local: bool = True):
+    def _host_batch_arrays(self, b):
         weights = np.zeros((b.label.shape[0],), np.float32)
         weights[:b.num_valid_examples] = 1.0
         weights *= b.row_valid   # drop rows whose label was truncated
-        arrays = (b.label, b.path_source_token_indices, b.path_indices,
-                  b.path_target_token_indices, b.context_valid_mask,
-                  b.cand_ids, b.cand_mask, weights)
+        return (b.label, b.path_source_token_indices, b.path_indices,
+                b.path_target_token_indices, b.context_valid_mask,
+                b.cand_ids, b.cand_mask, weights)
+
+    def _device_batch(self, b, process_local: bool = True):
+        arrays = self._host_batch_arrays(b)
         if self.mesh is not None:
             return shard_batch(self.mesh, arrays,
                                process_local=process_local)
-        return arrays
+        # materialize on device HERE (async dispatch) so the prefetch
+        # thread really transfers ahead — numpy passed into the jitted
+        # step would transfer on the MAIN thread at call time
+        return tuple(jnp.asarray(a) for a in arrays)
 
     def train(self) -> None:
         cfg = self.config
@@ -147,9 +153,11 @@ class VarMisuseModel:
         profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
                                 cfg.PROFILE_STEPS, self.log)
         steps_into_training = 0
-        from code2vec_tpu.data.prefetch import prefetch_to_device
-        infeed = prefetch_to_device(reader, self._device_batch,
-                                    cfg.INFEED_PREFETCH)
+        from code2vec_tpu.data.prefetch import build_train_infeed
+        infeed = build_train_infeed(
+            reader, chunk=cfg.INFEED_CHUNK, depth=cfg.INFEED_PREFETCH,
+            mesh=self.mesh, host_arrays_fn=self._host_batch_arrays,
+            device_batch_fn=self._device_batch, log=self.log)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
             for dev_batch, batch in infeed:
                 profiler.tick(steps_into_training, self.params)

@@ -38,7 +38,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref):
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-    logits = logits * (1.0 / (hd ** 0.5)) + mask_ref[0][None, :]
+    logits = logits * (1.0 / (hd ** 0.5)) + mask_ref[0]  # [1,C] bcast
     m = jnp.max(logits, axis=-1, keepdims=True)
     e = jnp.exp(logits - m)
     attn = e / jnp.sum(e, axis=-1, keepdims=True)
@@ -57,7 +57,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref,
     do = do_ref[0, 0].astype(jnp.float32)
     # recompute the softmax in-VMEM (never materialized in HBM)
     logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-    logits = logits * scale + mask_ref[0][None, :]
+    logits = logits * scale + mask_ref[0]        # [1, C] broadcast
     m = jnp.max(logits, axis=-1, keepdims=True)
     e = jnp.exp(logits - m)
     attn = e / jnp.sum(e, axis=-1, keepdims=True)          # [C, C]
@@ -77,8 +77,14 @@ def _bwd_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref,
 
 
 def _specs(B, H, C, hd):
+    # Mosaic requires each block's trailing two dims be sublane/lane
+    # aligned OR equal to the full array dims. q/k/v blocks end in
+    # (C, hd) == the array's (C, hd); the mask is passed as [B, 1, C]
+    # so its block (1, 1, C) ends in (1, C) == the array's (1, C) —
+    # a [B, C] layout would put block-size 1 against the B dim, which
+    # real TPU lowering rejects (interpret mode does not check this).
     qkv = pl.BlockSpec((1, 1, C, hd), lambda b, h: (b, h, 0, 0))
-    mask = pl.BlockSpec((1, C), lambda b, h: (b, 0))
+    mask = pl.BlockSpec((1, 1, C), lambda b, h: (b, 0, 0))
     return qkv, mask
 
 
@@ -95,7 +101,7 @@ def _mha_fwd_pallas(q, k, v, log_mask, interpret=None):
         out_specs=qkv_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, C, hd), q.dtype),
         interpret=interpret,
-    )(q, k, v, log_mask.astype(jnp.float32))
+    )(q, k, v, log_mask.astype(jnp.float32)[:, None, :])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -112,7 +118,7 @@ def _mha_bwd_pallas(q, k, v, log_mask, do, interpret=None):
         out_specs=(qkv_spec, qkv_spec, qkv_spec),
         out_shape=(shape, shape, shape),
         interpret=interpret,
-    )(q, k, v, log_mask.astype(jnp.float32), do)
+    )(q, k, v, log_mask.astype(jnp.float32)[:, None, :], do)
 
 
 @jax.custom_vjp

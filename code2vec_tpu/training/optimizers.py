@@ -103,9 +103,7 @@ def make_lr(learning_rate: float, schedule: str = "constant",
         return optax.linear_schedule(learning_rate, learning_rate * 0.1,
                                      total_steps)
     if schedule == "warmup_cosine":
-        w = warmup_steps if warmup_steps > 0 else max(1,
-                                                      total_steps // 20)
-        w = min(w, max(1, total_steps - 1))
+        w = warmup_length(total_steps, warmup_steps)
         # optax cosine-decays over (decay_steps - warmup_steps), which
         # must stay positive — eval/predict-only loads build the
         # schedule with horizon 1 just for opt_state STRUCTURE
@@ -115,6 +113,17 @@ def make_lr(learning_rate: float, schedule: str = "constant",
             decay_steps=max(total_steps, w + 1),
             end_value=0.1 * learning_rate)
     raise ValueError(f"unknown lr schedule {schedule!r}")
+
+
+def warmup_length(total_steps: int, warmup_steps: int) -> int:
+    """The EFFECTIVE warmup length make_lr uses: explicit if given,
+    else 5% of the horizon, clamped inside it. Exposed so
+    build_optimizer can resolve auto-warmup to a concrete number at
+    first training — the checkpoint manifest must record the effective
+    value, or a resume would re-derive a different auto length from
+    its extended horizon and follow a different LR trajectory."""
+    w = warmup_steps if warmup_steps > 0 else max(1, total_steps // 20)
+    return min(w, max(1, total_steps - 1))
 
 
 def schedule_total_steps(num_examples: int, batch_size: int, epochs: int,
@@ -140,6 +149,27 @@ def resolve_checkpoint_schedule(requested: str, manifest: dict,
             f"checkpoint's {ckpt_schedule!r} (the optimizer state "
             "structure is fixed at first training)")
     return ckpt_schedule
+
+
+def resolve_checkpoint_warmup(schedule: str, requested: int,
+                              manifest: dict, log) -> int:
+    """Companion to resolve_checkpoint_schedule, with the same logging
+    contract: the checkpoint's EFFECTIVE warmup length wins (the LR
+    trajectory is fixed at first training), a conflicting CLI
+    --warmup_steps is logged rather than silently dropped, and a
+    warmup aimed at a non-warmup schedule is logged+zeroed (the
+    combination Config.verify rejects on the fresh-training path)."""
+    if schedule != "warmup_cosine":
+        if requested > 0:
+            log(f"--warmup_steps {requested} ignored: the checkpoint's "
+                f"schedule is {schedule!r} (no warmup phase)")
+        return 0
+    ckpt_warmup = int(manifest.get("lr_warmup_steps", 0))
+    if ckpt_warmup > 0 and requested > 0 and requested != ckpt_warmup:
+        log(f"--warmup_steps {requested} ignored: using the "
+            f"checkpoint's effective warmup {ckpt_warmup} (the LR "
+            "trajectory is fixed at first training)")
+    return ckpt_warmup if ckpt_warmup > 0 else requested
 
 
 def make_optimizer(learning_rate,

@@ -429,6 +429,34 @@ def test_rename_augment_semantics(trained):
     assert np.array_equal(np.asarray(out1[2]), pth)
     assert np.array_equal(np.asarray(out1[4]), mask)
 
+    # mode="batch": same rename semantics, but the replacement is
+    # another example's variable (wrong-class cue injection) — so every
+    # introduced token must already occur somewhere in the ORIGINAL
+    # batch and be legal (round-4 positive-control defense)
+    outb = make_rename_augment(legal, 1.0, mode="batch")(
+        batch, jax.random.PRNGKey(2))
+    srcb, dstb = np.asarray(outb[1]), np.asarray(outb[3])
+    batch_tokens = set(np.unique(np.concatenate(
+        [src[mask > 0], dst[mask > 0]])).tolist())
+    from_batch = renamed = 0
+    for i in range(len(methods)):
+        changed = src[i] != srcb[i]
+        if not changed.any():
+            continue
+        new = np.unique(srcb[i][changed])
+        assert len(new) == 1
+        assert legal[int(new[0])]
+        renamed += 1
+        from_batch += int(new[0]) in batch_tokens
+    # donors with no legal slot fall back to a uniform legal draw
+    # (defense.py), so not EVERY replacement must come from the batch —
+    # but the distinguishing property of batch mode is that they
+    # overwhelmingly do (a uniform draw over the full vocab would land
+    # in this tiny batch's token set with negligible probability)
+    assert renamed > 0 and from_batch >= max(1, renamed - 1), (
+        f"batch-mode replacements not batch-sourced: "
+        f"{from_batch}/{renamed}")
+
 
 def test_adversarial_training_converges(trained):
     _, _, prefix = trained

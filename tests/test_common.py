@@ -105,3 +105,14 @@ def test_new_lr_flags_verified():
     cfg2.train_data_path = "/tmp/x"
     with pytest.raises(ValueError):
         cfg2.verify()
+
+
+def test_infeed_chunk_requires_thread():
+    import pytest
+
+    from code2vec_tpu.config import Config
+
+    cfg = Config(INFEED_CHUNK=4, INFEED_PREFETCH=0)
+    cfg.train_data_path = "/tmp/x"
+    with pytest.raises(ValueError, match="producer thread"):
+        cfg.verify()

@@ -180,3 +180,23 @@ def test_trust_ratio_rescales_per_array():
         ratio2 = norm(up_t["transform"]) / norm(params["transform"])
         assert abs(ratio - 1e-3) / 1e-3 < 0.05, (eopt, ratio)
         assert abs(ratio2 - 1e-3) / 1e-3 < 0.05, (eopt, ratio2)
+
+
+def test_resolve_checkpoint_warmup():
+    from code2vec_tpu.training.optimizers import resolve_checkpoint_warmup
+
+    msgs = []
+    # schedule pinned to a non-warmup one: warmup is zeroed with a log
+    assert resolve_checkpoint_warmup("cosine", 50, {}, msgs.append) == 0
+    assert msgs and "ignored" in msgs[0]
+    msgs.clear()
+    # checkpoint's effective warmup wins; a conflicting CLI value logs
+    assert resolve_checkpoint_warmup(
+        "warmup_cosine", 100, {"lr_warmup_steps": 3}, msgs.append) == 3
+    assert msgs and "ignored" in msgs[0]
+    msgs.clear()
+    # pre-round-4 checkpoint (no key): CLI value passes through
+    assert resolve_checkpoint_warmup("warmup_cosine", 50, {},
+                                     msgs.append) == 50
+    assert resolve_checkpoint_warmup("cosine", 0, {}, msgs.append) == 0
+    assert not msgs

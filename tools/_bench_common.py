@@ -1,0 +1,55 @@
+"""Shared java-large benchmark constants + slope-timing helpers for the
+round-4 measurement tools (bench_reconcile.py, xf_profile.py).
+
+bench.py and tools/profile_step.py keep their own self-contained copies
+deliberately — bench.py is the driver artifact (run standalone at repo
+root every round, must not grow import edges) and profile_step.py is
+the round-3 provenance tool; THIS module is the single source for new
+tools so shape/methodology fixes stop fanning out (advisor round-4
+reuse finding: the bf16-tables fix had to be applied in two places).
+"""
+
+from __future__ import annotations
+
+import time
+
+# java-large capacities (SURVEY.md §3 config row) — match bench.py
+TOKEN_VOCAB = 1_301_136
+PATH_VOCAB = 911_417
+TARGET_VOCAB = 261_245
+BATCH = 1024
+CTX = 200
+NUM_SAMPLED = 4096
+
+
+def slope_time(chain, state, steps: int, warmup: int = 5,
+               base: int = 10):
+    """Slope timing (BASELINE.md methodology): run chains of `base` and
+    `base+steps` calls and difference, cancelling the tunneled
+    platform's fixed ~100 ms sync cost. `chain(n, state) -> (seconds,
+    state)` must hard-sync via a host transfer of a SCALAR
+    (block_until_ready can return early here; transferring a full
+    tensor drowns the slope in transfer noise — both failure modes are
+    measured, see tools/xf_profile.py round-4 history)."""
+    _, state = chain(warmup, state)
+    t1, state = chain(base, state)
+    t2, state = chain(base + steps, state)
+    return (t2 - t1) / steps
+
+
+def time_fn(fn, args, steps: int, sync=None):
+    """Slope-time a stateless `fn(*args)` with a scalar-slice sync."""
+    if sync is None:
+        def sync(o):
+            import jax.numpy as jnp
+            return float(jnp.ravel(o)[0])
+
+    def chain(n, _):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        sync(out)
+        return time.perf_counter() - t0, None
+
+    return slope_time(chain, None, steps)

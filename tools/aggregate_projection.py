@@ -28,11 +28,15 @@ import sys
 
 V4_32_CHIPS = 16
 NORTH_STAR_MULTIPLE = 8.0
-# Large global batches are NOT convergence-neutral at matched token
-# budget (BASELINE.md large-batch study): budget extra tokens for the
-# 16-way-DP global batch. 2x is conservative — the measured worst gap
-# was 1.7 F1 at 8x batch growth with a tuned LR.
-TOKEN_BUDGET_PENALTY = 2.0
+# Token-budget penalties are MEASURED per mesh (BASELINE.md round-4
+# large-batch study, warmup_cosine + sqrt-scaled LR recipe):
+#   - data=4 x model=4 (recommended): global batch 4096 is
+#     convergence-NEUTRAL at matched budget (F1 0.9305 vs control
+#     0.9292) -> penalty 1.0.
+#   - pure DP16: global batch 16384 needs 2x tokens to match
+#     (0.8873 at 1x, 0.9270 at 2x vs control 0.9292) -> penalty 2.0.
+TOKEN_PENALTY = {"data4xmodel4_rowsharded": 1.0,
+                 "pure_dp16_replicated": 2.0}
 
 # ---- model shapes (java-large; SURVEY.md §3 config row), padded the
 # way models/encoder.ModelDims pads (vocab_pad_multiple here = the
@@ -158,8 +162,19 @@ def main() -> None:
     band = j.get("baseline_band", (denom, denom))
     step_ms = j.get("ms_per_step", 1024 * CTX / per_chip * 1e3)
     comm = collective_model(per_chip_batch=1024, step_ms=step_ms)
+    mesh = comm["recommended_mesh"]
     eff = comm["modeled_efficiency"]
+    penalty = TOKEN_PENALTY[mesh]
     agg = per_chip * V4_32_CHIPS * eff
+    ttq = agg / denom / penalty
+    # the worse mesh's time-to-quality, so the claim never rests on a
+    # single configuration
+    worse = ("pure_dp16_replicated"
+             if mesh == "data4xmodel4_rowsharded"
+             else "data4xmodel4_rowsharded")
+    ttq_worse = (per_chip * V4_32_CHIPS
+                 * comm[worse]["dp_efficiency"]
+                 / denom / TOKEN_PENALTY[worse])
     out = {
         "per_chip_pc_per_sec": per_chip,
         "per_chip_vs_v100": round(per_chip / denom, 2),
@@ -168,16 +183,20 @@ def main() -> None:
         "v4_32_modeled_vs_v100": round(agg / denom, 1),
         "v4_32_modeled_vs_v100_band": [round(agg / band[1], 1),
                                        round(agg / band[0], 1)],
-        "token_budget_penalty": TOKEN_BUDGET_PENALTY,
-        "v4_32_time_to_quality_vs_v100": round(
-            agg / denom / TOKEN_BUDGET_PENALTY, 1),
+        "token_budget_penalty": penalty,
+        "token_penalty_basis": "measured (BASELINE.md round-4 "
+                               "large-batch study): global B=4096 "
+                               "neutral at 1x budget; B=16384 matches "
+                               "at 2x",
+        "v4_32_time_to_quality_vs_v100": round(ttq, 1),
+        "v4_32_time_to_quality_worse_mesh": round(ttq_worse, 1),
         "north_star_multiple": NORTH_STAR_MULTIPLE,
-        "north_star_met": bool(agg / denom / TOKEN_BUDGET_PENALTY
+        "north_star_met": bool(min(ttq, ttq_worse)
                                >= NORTH_STAR_MULTIPLE),
         "assumes": "the modeled DP efficiency above on the recommended "
                    "mesh (dryrun-validated shardings; real multi-chip "
-                   "not measurable here) and the token penalty for the "
-                   "16x global batch (BASELINE.md large-batch study)",
+                   "not measurable here); token penalties are measured "
+                   "per mesh, not assumed",
     }
     print(json.dumps(out, indent=1))
 

@@ -39,14 +39,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-TOKEN_VOCAB = 1_301_136
-PATH_VOCAB = 911_417
-TARGET_VOCAB = 261_245
-B = 1024
-CTX = 200
-NUM_SAMPLED = 4096
-WARMUP = 5
+from _bench_common import (BATCH as B, CTX, NUM_SAMPLED, PATH_VOCAB,  # noqa: E402
+                           TARGET_VOCAB, TOKEN_VOCAB, slope_time)
 
 
 def _dims(tables_dtype: str):
@@ -71,13 +67,6 @@ def _batches(n: int):
             np.ones((B, CTX), np.float32),
             np.ones((B,), np.float32))))
     return out
-
-
-def _slope(chain, state, steps):
-    _, state = chain(WARMUP, state)
-    t1, state = chain(10, state)
-    t2, state = chain(10 + steps, state)
-    return (t2 - t1) / steps
 
 
 def time_full_step(dims, n_batches: int, split_in_loop: bool,
@@ -114,7 +103,7 @@ def time_full_step(dims, n_batches: int, split_in_loop: bool,
         return time.perf_counter() - t0, (params, opt_state, rng)
 
     state = (params, opt.init(params), jax.random.PRNGKey(1))
-    return _slope(chain, state, steps)
+    return slope_time(chain, state, steps)
 
 
 def time_fwd_bwd(dims, n_batches: int, steps: int) -> float:
@@ -141,7 +130,7 @@ def time_fwd_bwd(dims, n_batches: int, steps: int) -> float:
         float(loss)
         return time.perf_counter() - t0, rng
 
-    return _slope(chain, jax.random.PRNGKey(3), steps)
+    return slope_time(chain, jax.random.PRNGKey(3), steps)
 
 
 def main() -> None:

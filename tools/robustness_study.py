@@ -34,7 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def run_arm(name: str, data: str, epochs: int, batch: int,
             adv_prob: float, n_attacks: int, max_renames: int,
-            seed: int, max_contexts: int, detect: bool = False) -> dict:
+            seed: int, max_contexts: int, detect: bool = False,
+            adv_mode: str = "uniform") -> dict:
     from code2vec_tpu.attacks.robustness import evaluate_robustness
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
@@ -55,6 +56,7 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
         USE_SAMPLED_SOFTMAX=True,
         NUM_SAMPLED_CLASSES=4096,
         ADV_RENAME_PROB=adv_prob,
+        ADV_RENAME_MODE=adv_mode,
     )
     cfg.train_data_path = data
     cfg.test_data_path = data + ".val.c2v"
@@ -75,6 +77,7 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
     row = {
         "arm": name,
         "adv_rename_prob": adv_prob,
+        "adv_rename_mode": adv_mode if adv_prob > 0 else "-",
         "epochs": epochs,
         "clean_subtoken_f1": round(clean.subtoken_f1, 4),
         "clean_top1": round(clean.topk_acc[0], 4),
@@ -98,6 +101,10 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=6)
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--adv_prob", type=float, default=0.3)
+    ap.add_argument("--adv_mode", default="uniform",
+                    choices=["uniform", "batch"],
+                    help="defended arm's replacement distribution "
+                         "(attacks/defense.py make_rename_augment)")
     ap.add_argument("--n_attacks", type=int, default=300)
     ap.add_argument("--max_renames", type=int, default=1)
     ap.add_argument("--max_contexts", type=int, default=200)
@@ -118,7 +125,8 @@ def main() -> int:
         prob = 0.0 if arm == "baseline" else a.adv_prob
         rows.append(run_arm(arm, a.data, a.epochs, a.batch, prob,
                             a.n_attacks, a.max_renames, a.seed,
-                            a.max_contexts, detect=a.detect))
+                            a.max_contexts, detect=a.detect,
+                            adv_mode=a.adv_mode))
     print(f"\n{'arm':<10} {'p':>4} {'cleanF1':>8} {'top1':>6} "
           f"{'atk-success':>11} {'atk-top1':>8}")
     for r in rows:

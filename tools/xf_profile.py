@@ -39,37 +39,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-TOKEN_VOCAB = 1_301_136
-PATH_VOCAB = 911_417
-TARGET_VOCAB = 261_245
-B = 1024
-CTX = 200
+from _bench_common import (BATCH as B, CTX, NUM_SAMPLED, PATH_VOCAB,  # noqa: E402
+                           TARGET_VOCAB, TOKEN_VOCAB, slope_time,
+                           time_fn)
+
 E = 128
-NUM_SAMPLED = 4096
-WARMUP = 4
-
-
-def slope(chain, state, steps):
-    _, state = chain(WARMUP, state)
-    t1, state = chain(8, state)
-    t2, state = chain(8 + steps, state)
-    return (t2 - t1) / steps
-
-
-def time_fn(fn, args, steps, sync=None):
-    """Slope-time fn(*args) with a host-transfer sync."""
-    sync = sync or (lambda o: float(np.asarray(o).ravel()[0]))
-
-    def chain(n, _):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(n):
-            out = fn(*args)
-        sync(out)
-        return time.perf_counter() - t0, None
-
-    return slope(chain, None, steps)
 
 
 def main() -> None:
@@ -190,42 +166,53 @@ def main() -> None:
                  + 2 * B * CTX * D)
     rec("encoder_fwd", dt, flops=enc_flops)
 
-    loss_fn = make_train_loss_fn(dims, use_sampled_softmax=True,
-                                 num_sampled=NUM_SAMPLED,
-                                 compute_dtype=jnp.bfloat16)
     head_flops = 2 * B * (NUM_SAMPLED + 1) * D
-    fwd = jax.jit(loss_fn)
     rng = jax.random.PRNGKey(1)
-    dt = time_fn(fwd, (params, batch, rng), args.steps,
-                 sync=lambda o: float(o))
-    rec("loss_fwd", dt, flops=enc_flops + head_flops)
+    on_tpu = jax.default_backend() == "tpu"
+    fb = full = None
+    # both attention paths: XLA einsum+softmax vs the fused Pallas
+    # kernel pair (ops/xf_attention.py) — the before/after of the
+    # [B,H,C,C] HBM materialization
+    for tag, use_pallas in (("xla", False), ("pallas", on_tpu)):
+        if tag == "pallas" and not on_tpu:
+            break  # interpret mode would measure the interpreter
+        loss_fn = make_train_loss_fn(dims, use_sampled_softmax=True,
+                                     num_sampled=NUM_SAMPLED,
+                                     compute_dtype=jnp.bfloat16,
+                                     use_pallas=use_pallas)
+        fwd = jax.jit(loss_fn)
+        dt = time_fn(fwd, (params, batch, rng), args.steps,
+                     sync=lambda o: float(o))
+        rec(f"loss_fwd_{tag}", dt, flops=enc_flops + head_flops)
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-    dt = time_fn(grad_fn, (params, batch, rng), args.steps,
-                 sync=lambda o: float(o[0]))
-    fb = rec("fwd_bwd", dt, flops=3 * (enc_flops + head_flops))
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        dt = time_fn(grad_fn, (params, batch, rng), args.steps,
+                     sync=lambda o: float(o[0]))
+        fb = rec(f"fwd_bwd_{tag}", dt,
+                 flops=3 * (enc_flops + head_flops))
 
-    opt = make_optimizer(1e-3)
-    step = make_train_step(dims, opt, use_sampled_softmax=True,
-                           num_sampled=NUM_SAMPLED,
-                           compute_dtype=jnp.bfloat16,
-                           use_pallas=jax.default_backend() == "tpu")
+        opt = make_optimizer(1e-3)
+        step = make_train_step(dims, opt, use_sampled_softmax=True,
+                               num_sampled=NUM_SAMPLED,
+                               compute_dtype=jnp.bfloat16,
+                               use_pallas=use_pallas)
 
-    def chain(n, state):
-        p, s, rng = state
-        rng, sub = jax.random.split(rng)
-        keys = list(jax.random.split(sub, max(n, 1)))
-        t0 = time.perf_counter()
-        for i in range(n):
-            p, s, loss = step(p, s, batch, keys[i])
-        float(loss)
-        return time.perf_counter() - t0, (p, s, rng)
+        def chain(n, state):
+            p, s, rng = state
+            rng, sub = jax.random.split(rng)
+            keys = list(jax.random.split(sub, max(n, 1)))
+            t0 = time.perf_counter()
+            for i in range(n):
+                p, s, loss = step(p, s, batch, keys[i])
+            float(loss)
+            return time.perf_counter() - t0, (p, s, rng)
 
-    dt = slope(chain, (params, opt.init(params), jax.random.PRNGKey(2)),
-               args.steps)
-    full = rec("full_step_adafactor", dt,
-               flops=3 * (enc_flops + head_flops),
-               extra={"pc_per_sec": round(B * CTX / dt, 1)})
+        dt = slope_time(
+            chain, (params, opt.init(params), jax.random.PRNGKey(2)),
+            args.steps)
+        full = rec(f"full_step_adafactor_{tag}", dt,
+                   flops=3 * (enc_flops + head_flops),
+                   extra={"pc_per_sec": round(B * CTX / dt, 1)})
 
     # ---- roofline statement ----
     util = (full["tflops_per_sec"]
