@@ -87,12 +87,14 @@ def _measure_hbm_ceiling() -> float:
 
 def _java_large_dims(encoder_type: str = "bag"):
     from code2vec_tpu.models.encoder import ModelDims
+    # xf_heads=3: the shipped default (head_dim 128 = MXU lane width;
+    # quality-identical to 4 heads, 9% faster — BASELINE.md round 4)
     return ModelDims(token_vocab_size=TOKEN_VOCAB,
                      path_vocab_size=PATH_VOCAB,
                      target_vocab_size=TARGET_VOCAB,
                      embeddings_size=128, max_contexts=MAX_CONTEXTS,
                      tables_dtype="bfloat16", encoder_type=encoder_type,
-                     xf_layers=2, xf_heads=4)
+                     xf_layers=2, xf_heads=3)
 
 
 def _device_batches(n: int = 4):

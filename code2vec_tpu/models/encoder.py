@@ -51,7 +51,10 @@ class ModelDims:
     # contexts, models/transformer_encoder.py; BASELINE.json configs[4]).
     encoder_type: str = "bag"
     xf_layers: int = 2
-    xf_heads: int = 4
+    # 3 -> head_dim 384/3 = 128 = one MXU lane width (shipped default,
+    # matches Config.XF_HEADS; quality-identical to 4, 9% faster
+    # through the fused kernels — BASELINE.md round 4)
+    xf_heads: int = 3
     xf_mlp_ratio: int = 4
     # Rematerialize each transformer layer in the backward pass
     # (jax.checkpoint): trades ~30% more FLOPs for O(layers) -> O(1)

@@ -116,3 +116,20 @@ def test_infeed_chunk_requires_thread():
     cfg.train_data_path = "/tmp/x"
     with pytest.raises(ValueError, match="producer thread"):
         cfg.verify()
+
+
+def test_round4_flags_plumb_through_cli():
+    from code2vec_tpu.config import Config
+
+    cfg = Config.load_from_args(
+        ["--data", "/tmp/x", "--lr_schedule", "warmup_cosine",
+         "--warmup_steps", "7", "--trust_ratio", "--infeed_prefetch",
+         "3", "--infeed_chunk", "4", "--adv_rename_prob", "0.2",
+         "--adv_rename_mode", "batch"])
+    assert cfg.LR_SCHEDULE == "warmup_cosine"
+    assert cfg.LR_WARMUP_STEPS == 7
+    assert cfg.TRUST_RATIO is True
+    assert cfg.INFEED_PREFETCH == 3
+    assert cfg.INFEED_CHUNK == 4
+    assert cfg.ADV_RENAME_PROB == 0.2
+    assert cfg.ADV_RENAME_MODE == "batch"

@@ -69,6 +69,32 @@ def test_fused_mha_backward_matches_reference():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_fused_mha_grouped_backward_matches_reference():
+    """B=16 -> G=8: the grouped (batch-blocked) kernels' g-indexed
+    unroll must match the reference in BOTH directions — the other
+    backward test runs at G=1, which would miss a g-indexing bug in
+    the unroll. Slightly looser tolerance: the grouped unroll changes
+    f32 accumulation order marginally (measured ~1.4e-4 max delta)."""
+    q, k, v, log_mask = _inputs(B=16, H=2, C=24, hd=16, seed=3)
+    out = fused_mha(q, k, v, log_mask)
+    ref = mha_reference(q, k, v, log_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(jnp.square(fused_mha(q, k, v, log_mask)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, log_mask)))
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_fused, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch at G=8")
+
+
 def test_fused_mha_odd_shapes():
     """C=200 / hd=96 — the real java-large transformer block shape
     (not lane-aligned; mosaic must pad internally)."""

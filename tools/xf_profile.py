@@ -52,7 +52,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--layers", type=int, default=2)
-    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=3)  # shipped default
+    # (hd=128 lane-aligned; --heads 4 reproduces the round-4
+    # before/after comparison)
     args = ap.parse_args()
     L, H = args.layers, args.heads
 
@@ -207,8 +209,11 @@ def main() -> None:
             float(loss)
             return time.perf_counter() - t0, (p, s, rng)
 
+        # the chained step DONATES its params/opt_state — feed it
+        # copies or the next tag's measurements read deleted arrays
+        p0 = jax.tree_util.tree_map(jnp.copy, params)
         dt = slope_time(
-            chain, (params, opt.init(params), jax.random.PRNGKey(2)),
+            chain, (p0, opt.init(p0), jax.random.PRNGKey(2)),
             args.steps)
         full = rec(f"full_step_adafactor_{tag}", dt,
                    flops=3 * (enc_flops + head_flops),
