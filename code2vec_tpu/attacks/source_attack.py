@@ -23,12 +23,17 @@ Validity guards: candidate new names exclude every identifier already
 present in the source (no shadowing/duplicate-declaration collisions),
 and the rename targets are restricted to identifiers that appear in a
 declaration position (`Type name`), so called methods and type names are
-not rewritten. The identifier mapping is still heuristic — the extractor
-normalizes leaf tokens (`common.split_to_subtokens`), so distinct
-identifiers can collapse to one vocab token, and the word-boundary
-rewrite does not parse string literals/comments. Acceptable for the
-attack setting: the rewritten file is re-extracted, so the reported
-prediction is always truthful.
+not rewritten. Since round 4 every Java-source scan and rewrite is
+COMMENT/STRING-AWARE: a lexical mask (`code_char_mask` — line/block
+comments, string and char literals with escapes) restricts the regexes
+to code regions, so `// int fake;` declares nothing, an identifier
+inside "a string literal" is neither renamed nor counted as occupied,
+and comment-heavy corpora no longer shrink the measured attack surface
+(round-3 weak #6). The identifier mapping is still heuristic — the
+extractor normalizes leaf tokens (`common.split_to_subtokens`), so
+distinct identifiers can collapse to one vocab token. Acceptable for
+the attack setting either way: the rewritten file is re-extracted, so
+the reported prediction is always truthful.
 """
 
 from __future__ import annotations
@@ -64,6 +69,89 @@ _DECL_RE = re.compile(
     r"\s+([a-z_][A-Za-z0-9_]*)\s*(?=[=;,):])")  # variable name
 
 
+def code_char_mask(source: str) -> List[bool]:
+    """True where source[i] is CODE — False inside // and /* */
+    comments, "string" / 'char' literals (backslash escapes honored),
+    and Java 15 text blocks (\"\"\"...\"\"\", which legally contain
+    unescaped double quotes — handled as their own state so an
+    embedded quote neither exposes the block's content nor inverts
+    the scanner for the code after it). A lexical scanner, not a
+    parser: enough to keep the attack's regexes out of text the
+    compiler ignores."""
+    mask = [True] * len(source)
+    i, n = 0, len(source)
+    state = "code"
+    while i < n:
+        c = source[i]
+        if state == "code":
+            two = source[i:i + 2]
+            if two == "//":
+                state = "line"
+                mask[i] = mask[i + 1] = False
+                i += 2
+                continue
+            if two == "/*":
+                state = "block"
+                mask[i] = mask[i + 1] = False
+                i += 2
+                continue
+            if source[i:i + 3] == '"""':
+                state = "text"
+                mask[i] = mask[i + 1] = mask[i + 2] = False
+                i += 3
+                continue
+            if c == '"':
+                state = "str"
+                mask[i] = False
+            elif c == "'":
+                state = "char"
+                mask[i] = False
+            i += 1
+            continue
+        mask[i] = False
+        if state == "line":
+            if c == "\n":
+                mask[i] = True  # the newline itself is code structure
+                state = "code"
+            i += 1
+        elif state == "block":
+            if source[i:i + 2] == "*/":
+                mask[i + 1] = False
+                i += 2
+                state = "code"
+            else:
+                i += 1
+        elif state == "text":
+            if c == "\\" and i + 1 < n:
+                mask[i + 1] = False
+                i += 2
+            elif source[i:i + 3] == '"""':
+                mask[i + 1] = mask[i + 2] = False
+                i += 3
+                state = "code"
+            else:
+                i += 1
+        else:  # str / char
+            quote = '"' if state == "str" else "'"
+            if c == "\\" and i + 1 < n:
+                mask[i + 1] = False
+                i += 2
+            else:
+                if c == quote:
+                    state = "code"
+                i += 1
+    return mask
+
+
+def mask_non_code(source: str) -> str:
+    """The source with every non-code character blanked to a space —
+    offsets (and therefore every regex match position) are preserved,
+    so scans on the masked text map 1:1 onto the original."""
+    mask = code_char_mask(source)
+    return "".join(c if m or c == "\n" else " "
+                   for c, m in zip(source, mask))
+
+
 def normalize_identifier(ident: str) -> str:
     return "|".join(split_to_subtokens(ident))
 
@@ -84,7 +172,7 @@ def declared_variables(source: str) -> List[str]:
     parser — but it excludes called methods and type names, which is
     what keeps the rewrite semantics-preserving."""
     out, seen = [], set()
-    for m in _DECL_RE.finditer(source):
+    for m in _DECL_RE.finditer(mask_non_code(source)):
         type_word, name = m.group(1), m.group(2)
         if type_word in _NOT_A_TYPE or name in _JAVA_KEYWORDS:
             continue
@@ -155,7 +243,8 @@ def identifiers_for_token(source: str, token_word: str,
                           language: str = "java") -> List[str]:
     """Source identifiers that normalize to the stored vocab token."""
     pool = (declared_for(source, language) if declared_only else
-            [m.group(0) for m in _IDENT_RE.finditer(source)
+            [m.group(0)
+             for m in _IDENT_RE.finditer(mask_non_code(source))
              if m.group(0) not in _JAVA_KEYWORDS])
     found, seen = [], set()
     for ident in pool:
@@ -166,7 +255,19 @@ def identifiers_for_token(source: str, token_word: str,
 
 
 def rename_in_source(source: str, old_ident: str, new_ident: str) -> str:
-    return re.sub(rf"\b{re.escape(old_ident)}\b", new_ident, source)
+    """Word-boundary rename restricted to CODE regions: occurrences
+    inside comments or string literals are untouched (they are not the
+    program's identifiers — and rewriting a string would change
+    behavior)."""
+    pat = re.compile(rf"\b{re.escape(old_ident)}\b")
+    masked = mask_non_code(source)
+    out, last = [], 0
+    for m in pat.finditer(masked):
+        out.append(source[last:m.start()])
+        out.append(new_ident)
+        last = m.end()
+    out.append(source[last:])
+    return "".join(out)
 
 
 def rename_in_source_python(source: str, old_ident: str,
@@ -217,14 +318,17 @@ def insert_dead_declaration(source: str, method_name_word: str,
     `method_name_word`. Returns the modified source, or None if the
     method isn't found."""
     skip = ordinal
-    for m in _IDENT_RE.finditer(source):
+    masked = mask_non_code(source)
+    for m in _IDENT_RE.finditer(masked):
         if normalize_identifier(m.group(0)) != method_name_word:
             continue
         # require a parameter list then a brace: it's a method, not a
         # use. The `[^{;)]*` between `)` and `{` rejects call sites in
         # conditions — `if (check()) {` leaves a stray `)` after the
-        # matched parens that a declaration never has.
-        rest = source[m.end():]
+        # matched parens that a declaration never has. Scanned on the
+        # code-masked text so a mention in a comment or string never
+        # matches (offsets are identical to the original).
+        rest = masked[m.end():]
         sig = re.match(r"\s*\([^)]*\)[^{;)]*\{", rest, re.S)
         if not sig:
             continue
@@ -290,7 +394,9 @@ class SourceAttack:
         valid as a NEW name (duplicate declarations / symbol capture)."""
         tv = self.attack.token_vocab
         ids = set()
-        for m in _IDENT_RE.finditer(source):
+        # code regions only: a name that appears solely in a comment
+        # or string binds nothing, so it stays usable as a new name
+        for m in _IDENT_RE.finditer(mask_non_code(source)):
             idx = tv.lookup_index(normalize_identifier(m.group(0)))
             if idx != tv.oov_index:
                 ids.add(idx)
@@ -349,7 +455,7 @@ class SourceAttack:
         not already present in the source (so its occurrence slots are
         exactly the inserted declaration's)."""
         used = {normalize_identifier(m.group(0))
-                for m in _IDENT_RE.finditer(source)}
+                for m in _IDENT_RE.finditer(mask_non_code(source))}
         tv = self.attack.token_vocab
         for idx in range(tv.size - 1, 1, -1):
             word = tv.lookup_word(idx)

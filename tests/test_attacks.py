@@ -513,3 +513,89 @@ def test_source_level_deadcode_attack(trained, tmp_path):
         for line in ("int index = value + count;", "return index;"):
             assert line in res.adversarial_source
         assert "int " in res.adversarial_source
+
+
+def test_source_scans_are_comment_and_string_aware():
+    """Round-4 fix for r3 weak #6: the Java source scans/rewrites must
+    ignore comments and string literals (a regex over raw text renamed
+    inside strings and counted commented-out declarations)."""
+    from code2vec_tpu.attacks.source_attack import (
+        code_char_mask, declared_variables, insert_dead_declaration,
+        mask_non_code, rename_in_source)
+
+    src = (
+        'class C {\n'
+        '  // int fakeDecl = 1; value in a comment\n'
+        '  /* value multi\n'
+        '     line int ghost = 2; */\n'
+        '  String s = "value + 1; int strDecl = 3;";\n'
+        '  char q = \'v\';\n'
+        '  char esc = \'\\\'\';  // escaped quote then value\n'
+        '  int compute(int value) {\n'
+        '    return value + 1; // value\n'
+        '  }\n'
+        '}\n')
+
+    mask = code_char_mask(src)
+    assert len(mask) == len(src)
+    masked = mask_non_code(src)
+    # comment/string contents blanked, code intact, offsets preserved
+    assert "fakeDecl" not in masked and "ghost" not in masked
+    assert "strDecl" not in masked
+    assert "int compute(int value)" in masked
+    assert len(masked) == len(src)
+
+    # declarations inside comments/strings don't exist
+    decls = declared_variables(src)
+    assert "value" in decls and "s" in decls and "q" in decls
+    assert "fakeDecl" not in decls and "ghost" not in decls
+    assert "strDecl" not in decls
+
+    # rename rewrites code occurrences ONLY
+    out = rename_in_source(src, "value", "abc")
+    assert "int compute(int abc)" in out
+    assert "return abc + 1;" in out
+    assert '"value + 1; int strDecl = 3;"' in out  # string untouched
+    assert "// int fakeDecl = 1; value in a comment" in out
+    assert "/* value multi" in out
+    assert out.count("abc") == 2
+
+    # dead-code insertion: the commented-out method mention is skipped
+    src2 = ('class D {\n'
+            '  // compute(int x) { old impl }\n'
+            '  int compute(int x) {\n'
+            '    return x;\n'
+            '  }\n'
+            '}\n')
+    mod = insert_dead_declaration(src2, "compute", "deadVar")
+    assert mod is not None
+    assert mod.index("deadVar") > mod.index("return") - 40
+    # inserted into the REAL method body, not after the comment
+    assert "// compute(int x) { old impl }\n  int compute" in mod
+
+
+def test_code_mask_handles_java_text_blocks():
+    """Java 15 text blocks legally contain unescaped double quotes; the
+    scanner must keep their content masked and return to CODE state at
+    the closing triple quote (review r4: an embedded quote previously
+    flipped the state and exposed/inverted everything after)."""
+    from code2vec_tpu.attacks.source_attack import (declared_variables,
+                                                    rename_in_source)
+
+    src = ('class T {\n'
+           '  String t = """\n'
+           '      hello "value" world\n'
+           '      """;\n'
+           '  int compute(int value) {\n'
+           '    return value + 1;\n'
+           '  }\n'
+           '}\n')
+    out = rename_in_source(src, "value", "abc")
+    assert 'hello "value" world' in out       # text block untouched
+    assert "int compute(int abc)" in out      # code renamed
+    assert "return abc + 1;" in out
+    # odd quote count inside the block must not invert the mask: the
+    # declarations AFTER the block are still seen
+    decls = declared_variables(src)
+    assert "t" in decls
+    assert "value" in decls  # the real parameter, after the block
