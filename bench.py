@@ -85,19 +85,21 @@ def _measure_hbm_ceiling() -> float:
     return measure_hbm_ceiling()
 
 
-def _java_large_dims(encoder_type: str = "bag"):
+def _java_large_dims(encoder_type: str = "bag",
+                     tables_dtype: str = "bfloat16",
+                     max_contexts: int = MAX_CONTEXTS):
     from code2vec_tpu.models.encoder import ModelDims
     # xf_heads=3: the shipped default (head_dim 128 = MXU lane width;
     # quality-identical to 4 heads, 9% faster — BASELINE.md round 4)
     return ModelDims(token_vocab_size=TOKEN_VOCAB,
                      path_vocab_size=PATH_VOCAB,
                      target_vocab_size=TARGET_VOCAB,
-                     embeddings_size=128, max_contexts=MAX_CONTEXTS,
-                     tables_dtype="bfloat16", encoder_type=encoder_type,
+                     embeddings_size=128, max_contexts=max_contexts,
+                     tables_dtype=tables_dtype, encoder_type=encoder_type,
                      xf_layers=2, xf_heads=3)
 
 
-def _device_batches(n: int = 4):
+def _device_batches(n: int = 4, max_contexts: int = MAX_CONTEXTS):
     """n distinct uniform-random batches, placed on device once (the
     rotation defeats any cross-step input caching; ids are uniform —
     the worst case for the embedding gathers)."""
@@ -108,13 +110,13 @@ def _device_batches(n: int = 4):
     for _ in range(n):
         arrays = (
             r.integers(0, TARGET_VOCAB, size=(BATCH,), dtype=np.int32),
-            r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+            r.integers(0, TOKEN_VOCAB, size=(BATCH, max_contexts),
                        dtype=np.int32),
-            r.integers(0, PATH_VOCAB, size=(BATCH, MAX_CONTEXTS),
+            r.integers(0, PATH_VOCAB, size=(BATCH, max_contexts),
                        dtype=np.int32),
-            r.integers(0, TOKEN_VOCAB, size=(BATCH, MAX_CONTEXTS),
+            r.integers(0, TOKEN_VOCAB, size=(BATCH, max_contexts),
                        dtype=np.int32),
-            np.ones((BATCH, MAX_CONTEXTS), dtype=np.float32),
+            np.ones((BATCH, max_contexts), dtype=np.float32),
             np.ones((BATCH,), dtype=np.float32))
         out.append(tuple(jnp.asarray(a) for a in arrays))
     return out
@@ -173,26 +175,30 @@ def _measure_fwd_bwd_floor():
     return BATCH * MAX_CONTEXTS / dt
 
 
-def _measure_encoder(encoder_type: str):
+def _measure_encoder(encoder_type: str, tables_dtype: str = "bfloat16",
+                     max_contexts: int = MAX_CONTEXTS):
     """Build the shipped train step for one encoder and time it.
     Returns (path_contexts_per_sec, ms_per_step, hbm_gbps)."""
     import jax
     import jax.numpy as jnp
 
     from code2vec_tpu.models.encoder import init_params
+    from code2vec_tpu.ops.quant import opt_param_view
     from code2vec_tpu.training.optimizers import make_optimizer
     from code2vec_tpu.training.steps import make_train_step
 
-    dims = _java_large_dims(encoder_type)
+    dims = _java_large_dims(encoder_type, tables_dtype, max_contexts)
     params = init_params(jax.random.PRNGKey(0), dims)
     optimizer = make_optimizer(1e-3)  # shipped default: adafactor tables
-    opt_state = optimizer.init(params)
+    # int8 tables: the optimizer sees the flat [V, E] view (shared
+    # helper so the structure can't drift from the model's)
+    opt_state = optimizer.init(opt_param_view(params))
     hbm_bytes = _step_hbm_bytes(params, opt_state)
     step = make_train_step(dims, optimizer, use_sampled_softmax=True,
                            num_sampled=NUM_SAMPLED,
                            compute_dtype=jnp.bfloat16,
                            use_pallas=jax.default_backend() == "tpu")
-    batches = _device_batches()
+    batches = _device_batches(max_contexts=max_contexts)
 
     def chain(n, state):
         """Run n chained steps; the donated-params chain serializes
@@ -212,7 +218,7 @@ def _measure_encoder(encoder_type: str):
         return time.perf_counter() - t0, (params, opt_state, rng)
 
     dt = _slope_time(chain, (params, opt_state, jax.random.PRNGKey(1)))
-    pc_per_sec = BATCH * MAX_CONTEXTS / dt
+    pc_per_sec = BATCH * max_contexts / dt
     return pc_per_sec, dt * 1e3, hbm_bytes / dt / 1e9
 
 
@@ -220,6 +226,7 @@ def main() -> None:
     ceiling = _measure_hbm_ceiling()
     value, ms, hbm_gbps = _measure_encoder("bag")
     floor = _measure_fwd_bwd_floor()
+    i8_value, i8_ms, _ = _measure_encoder("bag", tables_dtype="int8")
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
     print(json.dumps({
         "metric": "path-contexts/sec/chip",
@@ -246,6 +253,12 @@ def main() -> None:
         # means the optimizer is no longer the lever (BASELINE.md)
         "fwd_bwd_floor_pc_per_sec": round(floor, 1),
         "optimizer_efficiency": round(value / floor, 3),
+        # sub-bf16 lever (ops/quant.py): int8 token/path tables +
+        # per-row scales, stochastic-rounding requantize
+        "int8_pc_per_sec": round(i8_value, 1),
+        "int8_ms_per_step": round(i8_ms, 2),
+        "int8_vs_baseline": round(
+            i8_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
         "transformer_pc_per_sec": round(xf_value, 1),
         "transformer_ms_per_step": round(xf_ms, 2),
         "transformer_hbm_gbps": round(xf_hbm, 1),

@@ -54,18 +54,25 @@ def main() -> int:
         mpath = os.path.join(config.load_path, "manifest.json")
         if os.path.exists(mpath):
             with open(mpath) as f:
-                ckpt_head = json.load(f).get("head", "code2vec")
+                manifest = json.load(f)
+            ckpt_head = manifest.get("head", "code2vec")
             if config.HEAD_EXPLICIT and ckpt_head != config.HEAD:
                 print(f"error: checkpoint was trained with --head "
                       f"{ckpt_head}, but --head {config.HEAD} was given",
                       file=sys.stderr)
                 return 2
             config.HEAD = ckpt_head
-    # Config.verify() ran before the manifest could set HEAD; re-run it
-    # now that the effective head is known — varmisuse checkpoints must
-    # reject the code2vec-only surfaces (--predict/--release/--attack/
-    # --save_w2v/--save_t2v/--export_code_vectors) with a clean error,
-    # not a downstream crash.
+            # tables_dtype gates surfaces the same way head does
+            # (--attack on an int8 checkpoint must fail the verify
+            # below, not crash in the attack's table matvec)
+            config.TABLES_DTYPE = manifest.get("tables_dtype",
+                                               config.TABLES_DTYPE)
+    # Config.verify() ran before the manifest could set HEAD or the
+    # dims set TABLES_DTYPE; re-run it now that the effective values are
+    # known — varmisuse checkpoints must reject the code2vec-only
+    # surfaces (--predict/--release/--attack/--save_w2v/--save_t2v/
+    # --export_code_vectors) and int8 checkpoints must reject --attack
+    # with a clean error, not a downstream crash.
     try:
         config.verify()
     except ValueError as e:

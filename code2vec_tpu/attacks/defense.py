@@ -89,12 +89,15 @@ def make_rename_augment(legal: np.ndarray, prob: float,
         slot_logits = jnp.where(eligible, 0.0, -1e9)
         j = jax.random.categorical(r_slot, slot_logits, axis=-1)
         tok = jnp.take_along_axis(all_tok, j[:, None], axis=1)[:, 0]
-        if mode == "batch":
+        if mode == "batch" and B > 1:
             # another example's selected variable = usually a
             # wrong-class cue; roll avoids i->i (shift in [1, B-1]).
             # Rows whose donor token is illegal (donor had no legal
             # slot) fall back to a uniform legal draw via `where`.
-            shift = jax.random.randint(r_new, (), 1, max(B, 2))
+            # B==1 (static shape) has no donor — roll over a length-1
+            # axis is the identity, i.e. a silent self-rename no-op —
+            # so it takes the uniform branch instead (ADVICE r4).
+            shift = jax.random.randint(r_new, (), 1, B)
             donor = jnp.roll(tok, shift)
             fallback = legal[jax.random.randint(
                 jax.random.fold_in(r_new, 1), (B,), 0, legal.shape[0])]

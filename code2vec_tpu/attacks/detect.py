@@ -54,11 +54,18 @@ class RarityDetector:
         rows = dims.padded(dims.token_vocab_size)
         total = sum(token_counts.values()) + rows  # add-one smoothing
         rarity = np.full((rows,), -np.log(1.0 / total), np.float32)
+        counts = np.zeros((rows,), np.int64)
         for idx, word in enumerate(token_vocab.to_word_list()):
             c = token_counts.get(word, 0)
             rarity[idx] = -np.log((c + 1.0) / total)
+            counts[idx] = c
         rarity[token_vocab.pad_index] = 0.0  # masked out anyway
         self.rarity = rarity
+        # per-row train counts, kept for the replacement-frequency
+        # mechanism report (evaluate_robustness: is the attack choosing
+        # rare-but-strong or common-but-weak replacements?)
+        self.counts = counts
+        self.token_vocab = token_vocab
         encode = get_encode_fn(dims)
 
         @jax.jit

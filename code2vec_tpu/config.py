@@ -58,6 +58,10 @@ class Config:
     # branch (training/optimizers.make_optimizer). Changes opt_state
     # structure -> recorded in the checkpoint manifest.
     TRUST_RATIO: bool = False
+    # "all" (round-4 behavior; measured harmful on tables) | "dense"
+    # (LAMB standard for embedding-dominated models: trust-scale
+    # TRANSFORM/ATTENTION/heads only — VERDICT r4 item 8)
+    TRUST_RATIO_SCOPE: str = "all"
     SEED: int = 239
 
     # ---- softmax strategy (TPU addition; SURVEY.md §3.3 requires sampled
@@ -86,7 +90,11 @@ class Config:
     # beat) f32 subtoken-F1 in the 50K-vocab quality study — both in
     # BASELINE.md — so it is the default; --tables_dtype float32
     # restores exact reference numerics.
-    TABLES_DTYPE: str = "bfloat16"  # "float32" | "bfloat16"
+    # "int8" (ops/quant.py) additionally stores the token/path tables
+    # as int8 rows + per-row scales — the sub-bf16 lever BASELINE.md's
+    # structural-bound analysis names; single-device bag-encoder
+    # training only (verify() gates the unsupported combinations).
+    TABLES_DTYPE: str = "bfloat16"  # "float32" | "bfloat16" | "int8"
     # Optimizer for the vocab tables: "adafactor" (factored second
     # moment, no momentum — the standard large-embedding-table practice)
     # or "adam" (reference parity). Adafactor is the default since
@@ -299,6 +307,8 @@ class Config:
                        default=None,
                        help="warmup_cosine warmup length "
                             "(0 = auto, 5%% of total steps)")
+        p.add_argument("--trust_ratio_scope", dest="trust_ratio_scope",
+                       default=None, choices=["all", "dense"])
         p.add_argument("--trust_ratio", dest="trust_ratio",
                        action="store_true",
                        help="LAMB-style per-array trust-ratio rescale "
@@ -329,7 +339,7 @@ class Config:
         p.add_argument("--max_candidates", dest="max_candidates",
                        type=int, default=None)
         p.add_argument("--tables_dtype", dest="tables_dtype", default=None,
-                       choices=["float32", "bfloat16"])
+                       choices=["float32", "bfloat16", "int8"])
         p.add_argument("--embedding_optimizer", dest="embedding_optimizer",
                        default=None, choices=["adam", "adafactor"])
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
@@ -425,6 +435,8 @@ class Config:
             cfg.LR_WARMUP_STEPS = ns.warmup_steps
         if ns.trust_ratio:
             cfg.TRUST_RATIO = True
+        if ns.trust_ratio_scope is not None:
+            cfg.TRUST_RATIO_SCOPE = ns.trust_ratio_scope
         if ns.infeed_prefetch is not None:
             cfg.INFEED_PREFETCH = ns.infeed_prefetch
         if ns.infeed_chunk is not None:
@@ -535,6 +547,36 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
                 "the adam embedding optimizer.")
+        if self.TABLES_DTYPE == "int8":
+            # the int8 path covers the shipped per-chip training config
+            # (bag encoder, single device); the gated combinations read
+            # the token/path tables as plain arrays (transformer/vm
+            # gathers, attack matvec, LAMB's ||param||) or shard by flat
+            # key (mesh rules) and would need the dequantized view.
+            if self.ENCODER_TYPE != "bag":
+                raise ValueError(
+                    "--tables_dtype int8 supports the bag encoder only "
+                    "(transformer_encoder gathers the tables directly).")
+            if self.HEAD != "code2vec":
+                raise ValueError(
+                    "--tables_dtype int8 supports the code2vec head "
+                    "only.")
+            if self.MESH_MODEL_AXIS > 1 or self.MESH_CONTEXT_AXIS > 1:
+                raise ValueError(
+                    "--tables_dtype int8 supports data-parallel meshes "
+                    "only (model/ctx sharding of {q, s} subtrees is "
+                    "untested; tables replicate under DP).")
+            if self.TRUST_RATIO:
+                raise ValueError(
+                    "--tables_dtype int8 is incompatible with "
+                    "--trust_ratio (the trust rescale needs ||param|| "
+                    "of the flat table the quantized step never "
+                    "materializes).")
+            if self.ATTACK:
+                raise ValueError(
+                    "--attack needs float/bf16 tables (the gradient "
+                    "attack's candidate matvec reads the table as one "
+                    "array); rerun with a bf16 checkpoint.")
         if self.LR_WARMUP_STEPS < 0:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:
@@ -553,6 +595,12 @@ class Config:
                 "--warmup_steps applies only to "
                 "--lr_schedule warmup_cosine (other schedules have no "
                 "warmup phase and would silently ignore it).")
+        if (self.TRUST_RATIO and self.TRUST_RATIO_SCOPE == "dense"
+                and self.EMBEDDING_OPTIMIZER != "adafactor"):
+            raise ValueError(
+                "--trust_ratio_scope dense requires "
+                "--embedding_optimizer adafactor (adam runs one "
+                "transform over all params; no table/dense split).")
         if self.TRUST_RATIO and self.SPARSE_EMBEDDING_UPDATES:
             raise ValueError(
                 "--trust_ratio is not supported with "

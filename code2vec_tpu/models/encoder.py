@@ -41,10 +41,16 @@ class ModelDims:
     dropout_keep_rate: float = 0.75
     # Row padding so vocab dims divide the 'model' mesh axis evenly.
     vocab_pad_multiple: int = 1
-    # Storage dtype of the three vocab tables ("float32" | "bfloat16").
+    # Storage dtype of the three vocab tables
+    # ("float32" | "bfloat16" | "int8").
     # bf16 tables halve the gather / scatter / optimizer HBM traffic that
     # dominates the java-large step (~30-40% end-to-end, measured on
-    # v5e-lite; see BASELINE.md). TRANSFORM/ATTENTION always stay f32.
+    # v5e-lite; see BASELINE.md). "int8" (ops/quant.py, VERDICT r4
+    # item 3) halves the token/path-table bytes AGAIN: int8 rows +
+    # per-row f32 scales, gather-level dequantization,
+    # stochastic-rounding requantize in the apply; target_emb stays
+    # bf16 (the sampled-softmax head matmuls against it).
+    # TRANSFORM/ATTENTION always stay f32.
     tables_dtype: str = "float32"
     # Encoder architecture: "bag" (the reference's single-query
     # attention pool) or "transformer" (set transformer over the
@@ -92,7 +98,8 @@ def init_params(rng: jax.Array, dims: ModelDims,
     D = dims.context_vector_size
     init = jax.nn.initializers.variance_scaling(
         1.0, "fan_avg", "uniform")
-    t_dtype = jnp.dtype(dims.tables_dtype)
+    quantized = dims.tables_dtype == "int8"
+    t_dtype = jnp.bfloat16 if quantized else jnp.dtype(dims.tables_dtype)
     params = {
         "token_emb": init(k_tok, (dims.padded(dims.token_vocab_size), E),
                           t_dtype),
@@ -103,11 +110,34 @@ def init_params(rng: jax.Array, dims: ModelDims,
         "transform": init(k_tr, (D, D), dtype),
         "attention": init(k_at, (D, 1), dtype)[:, 0],
     }
+    if quantized:
+        # int8 + per-row scale for the two leaf-token tables;
+        # target_emb stays bf16 (ops/quant.py module docstring)
+        from code2vec_tpu.ops.quant import (QUANTIZED_TABLE_KEYS,
+                                            quantize_table)
+        for k in QUANTIZED_TABLE_KEYS:
+            params[k] = quantize_table(params[k])
     if dims.encoder_type == "transformer":
         from code2vec_tpu.models.transformer_encoder import init_xf_params
         params["xf"] = init_xf_params(
             jax.random.fold_in(rng, 0x5f), dims)
     return params
+
+
+def take_rows(params: Params, name: str, ids: jax.Array) -> jax.Array:
+    """Embedding-row gather that understands the three table storages:
+    plain float arrays, {"q","s"} int8 tables (no-grad dequantizing
+    gather — eval/predict/serving), and {"q","s","g"} int8 tables with
+    a gradient carrier attached by the quantized train step (the
+    straight-through custom_vjp gather; ops/quant.py)."""
+    t = params[name]
+    if isinstance(t, dict):
+        if "g" in t:
+            from code2vec_tpu.ops.quant import quantized_take
+            return quantized_take(t["g"], t, ids)
+        return (jnp.take(t["q"], ids, axis=0).astype(t["s"].dtype)
+                * jnp.take(t["s"], ids, axis=0))
+    return jnp.take(t, ids, axis=0)
 
 
 def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
@@ -123,9 +153,9 @@ def encode(params: Params, source_ids: jax.Array, path_ids: jax.Array,
     attention [B, C] f32). use_pallas selects the fused Pallas pooling
     kernel (ops/pallas_attention.py).
     """
-    src = jnp.take(params["token_emb"], source_ids, axis=0)
-    pth = jnp.take(params["path_emb"], path_ids, axis=0)
-    dst = jnp.take(params["token_emb"], target_ids, axis=0)
+    src = take_rows(params, "token_emb", source_ids)
+    pth = take_rows(params, "path_emb", path_ids)
+    dst = take_rows(params, "token_emb", target_ids)
     contexts = jnp.concatenate([src, pth, dst], axis=-1).astype(compute_dtype)
 
     if dropout_rng is not None and dropout_keep_rate < 1.0:

@@ -50,6 +50,17 @@ class Code2VecModel(Code2VecModelBase):
         # sharded vocab tables; single-device runs use no mesh. ----
         from code2vec_tpu.models.setup import build_mesh, build_optimizer
         self.mesh = build_mesh(cfg)
+        if cfg.TABLES_DTYPE == "int8" and self.mesh is not None:
+            # data-parallel meshes replicate the quantized tables and
+            # psum the carrier grads — supported (tested on the virtual
+            # 8-device mesh). Model/context sharding of {q, s} subtrees
+            # is not: verify() rejects the explicit flags, this catches
+            # an implicit multi-axis mesh.
+            shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            if shape.get("model", 1) > 1 or shape.get("ctx", 1) > 1:
+                raise ValueError(
+                    "--tables_dtype int8 supports data-parallel meshes "
+                    f"only; got mesh {shape}")
         model_axis = max(1, cfg.MESH_MODEL_AXIS)
         self.shard_contexts = max(1, cfg.MESH_CONTEXT_AXIS) > 1
 
@@ -75,6 +86,8 @@ class Code2VecModel(Code2VecModelBase):
             # trust_ratio changes opt_state structure exactly like the
             # optimizer choice does; pre-round-4 checkpoints never had it
             cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
+            cfg.TRUST_RATIO_SCOPE = manifest.get("trust_ratio_scope",
+                                                 "all")
             from code2vec_tpu.training.optimizers import (
                 resolve_checkpoint_schedule, resolve_checkpoint_warmup)
             cfg.LR_SCHEDULE = resolve_checkpoint_schedule(
@@ -132,7 +145,7 @@ class Code2VecModel(Code2VecModelBase):
             opt_state = init_sparse_opt_state(params, self.optimizer,
                                               cfg.USE_SAMPLED_SOFTMAX)
         else:
-            opt_state = self.optimizer.init(params)
+            opt_state = self.optimizer.init(self._opt_param_view(params))
         if cfg.is_loading:
             if manifest.get("released"):
                 loaded = ckpt.load_checkpoint(cfg.load_path,
@@ -431,6 +444,7 @@ class Code2VecModel(Code2VecModelBase):
                      self.config.SPARSE_EMBEDDING_UPDATES,
                  "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
                  "trust_ratio": self.config.TRUST_RATIO,
+                 "trust_ratio_scope": self.config.TRUST_RATIO_SCOPE,
                  # always the EFFECTIVE schedule: for loaded models the
                  # manifest override already set cfg.LR_SCHEDULE to what
                  # the saved opt_state structure carries
@@ -451,11 +465,21 @@ class Code2VecModel(Code2VecModelBase):
         ckpt.release_checkpoint(cfg.load_path, dest, self.params)
         self.log(f"released inference checkpoint -> {dest}")
 
+    @staticmethod
+    def _opt_param_view(params):
+        """See ops/quant.opt_param_view (shared with bench.py so the
+        opt_state structure can never drift between them)."""
+        from code2vec_tpu.ops.quant import opt_param_view
+        return opt_param_view(params)
+
     def get_embedding_table(self, vocab_type: VocabType) -> np.ndarray:
         key = {VocabType.Token: "token_emb", VocabType.Path: "path_emb",
                VocabType.Target: "target_emb"}[vocab_type]
-        table = np.asarray(jax.device_get(self.params[key]),
-                           dtype=np.float32)
+        from code2vec_tpu.ops.quant import dequantize_table, is_quantized
+        table = self.params[key]
+        if is_quantized(table):
+            table = dequantize_table(table)
+        table = np.asarray(jax.device_get(table), dtype=np.float32)
         return table[:self.vocabs.get(vocab_type).size]
 
     def export_code_vectors_file(self, test_path: str,

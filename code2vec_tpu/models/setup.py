@@ -66,4 +66,5 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
     return make_optimizer(
         make_lr(cfg.LEARNING_RATE, schedule, total_steps,
                 warmup_steps=cfg.LR_WARMUP_STEPS),
-        cfg.EMBEDDING_OPTIMIZER, trust_ratio=cfg.TRUST_RATIO)
+        cfg.EMBEDDING_OPTIMIZER, trust_ratio=cfg.TRUST_RATIO,
+        trust_ratio_scope=cfg.TRUST_RATIO_SCOPE)

@@ -35,7 +35,14 @@ from jax.experimental import pallas as pl
 _G = 8  # batch rows per program: B*H/G programs of G fused attention
 # blocks each — at (1024, 4) and G=1 the grid is 4096 tiny programs
 # whose launch overhead eats the fusion win (measured round 4); G=8
-# keeps VMEM ~1.5 MB/program and amortizes the launch 8x.
+# amortizes the launch 8x. Per-program VMEM at (C=200, hd=128), G=8
+# (ADVICE r4: the old "~1.5 MB" figure was wrong):
+#   fwd: 4 refs [G,1,C,hd] bf16 (q/k/v/o) = 1.6 MB + per-g f32 working
+#        set (~0.3 MB q/k/v rows + ~0.5 MB [C,C] logits/e/attn) ~2.6 MB
+#   bwd: 8 refs (5 in + 3 out) = 3.3 MB + ~1.1 MB f32 temps     ~4.4 MB
+# Both sit well inside the ~16 MB budget; they scale linearly in G and
+# hd and QUADRATICALLY in C (the [C,C] temps) — check before raising
+# any of the three.
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref):

@@ -55,8 +55,18 @@ def context_batch_pspec() -> P:
 
 def shard_params(mesh: Mesh, params) -> Dict[str, jax.Array]:
     specs = param_pspecs()
-    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-            for k, v in params.items()}
+
+    def put(k, v):
+        if isinstance(v, dict) and "q" in v:
+            # int8 quantized table (ops/quant.py): rows shard like the
+            # flat table would — q [V, E] and s [V, 1] both lead with
+            # the vocab dim (data-parallel meshes replicate both)
+            spec = specs[k]
+            return {"q": jax.device_put(v["q"], NamedSharding(mesh, spec)),
+                    "s": jax.device_put(v["s"], NamedSharding(mesh, spec))}
+        return jax.device_put(v, NamedSharding(mesh, specs[k]))
+
+    return {k: put(k, v) for k, v in params.items()}
 
 
 def shard_opt_state(mesh: Mesh, opt_state, params):

@@ -88,6 +88,9 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
         num_sampled=num_sampled, compute_dtype=compute_dtype,
         use_pallas=use_pallas, mesh=mesh)
 
+    if dims.tables_dtype == "int8":
+        return _make_quantized_train_step(optimizer, loss_fn, augment_fn)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
         if augment_fn is not None:
@@ -97,6 +100,63 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    return step
+
+
+def _make_quantized_train_step(optimizer, loss_fn, augment_fn):
+    """The int8-tables train step (ops/quant.py; VERDICT r4 item 3).
+
+    Differs from the float step in exactly three ways:
+    1. gradients for the quantized tables flow to zero "carriers"
+       created inside the step — the straight-through custom_vjp routes
+       each table's dense [V, E] cotangent there, and XLA DCEs the
+       zeros in the forward, so the carriers cost no HBM traffic beyond
+       the scatter-add every table gradient already pays;
+    2. the optimizer sees a FLAT gradient view (one [V, E] array per
+       table, same keys/structure as the float path), so opt_state
+       structure and the multi_transform labels are unchanged;
+    3. the apply requantizes: dequant + update + stochastic-rounding
+       int8 round-trip per table (ops/quant.requantize), instead of
+       optax.apply_updates' dense add.
+    """
+    from code2vec_tpu.ops.quant import is_quantized, requantize
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        if augment_fn is not None:
+            rng, aug_rng = jax.random.split(rng)
+            batch = augment_fn(batch, aug_rng)
+        qkeys = sorted(k for k in params if is_quantized(params[k]))
+        rng, loss_rng, *qrngs = jax.random.split(rng, 2 + len(qkeys))
+
+        def lf(carriers, params):
+            virt = dict(params)
+            for k, c in carriers.items():
+                virt[k] = dict(params[k], g=c)
+            return loss_fn(virt, batch, loss_rng)
+
+        carriers = {k: jnp.zeros(params[k]["q"].shape, jnp.bfloat16)
+                    for k in qkeys}
+        loss, (g_tables, g_rest) = jax.value_and_grad(
+            lf, argnums=(0, 1), allow_int=True)(carriers, params)
+        flat_grads = {k: (g_tables[k] if k in g_tables else g_rest[k])
+                      for k in params}
+        # optax's factored_rms requires a params arg even when
+        # multiply_by_parameter_scale=False (shape-only use); give the
+        # quantized tables flat zero stand-ins matching the grad view —
+        # their VALUES are never read, so XLA drops the zeros
+        flat_params = {k: (carriers[k] if k in carriers else params[k])
+                       for k in params}
+        updates, opt_state = optimizer.update(flat_grads, opt_state,
+                                              flat_params)
+        new_params = {}
+        for k, qrng in zip(qkeys, qrngs):
+            new_params[k] = requantize(params[k], updates[k], qrng)
+        for k in params:
+            if k not in new_params:
+                new_params[k] = optax.apply_updates(params[k], updates[k])
+        return new_params, opt_state, loss
 
     return step
 

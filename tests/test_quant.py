@@ -1,0 +1,257 @@
+"""int8 quantized-table tests (ops/quant.py; VERDICT r4 item 3).
+
+Covers: quantize/dequantize error bounds, the straight-through gather's
+gradient correctness against a float-table reference, untouched-row
+requantize stability, and an end-to-end quantized train step (loss
+decreases, structure preserved, optimizer flat-view compatibility).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.encoder import ModelDims, init_params, take_rows
+from code2vec_tpu.ops.quant import (dequantize_table, is_quantized,
+                                    quantize_table, quantized_take,
+                                    requantize)
+from code2vec_tpu.training.optimizers import make_optimizer
+from code2vec_tpu.training.steps import make_train_step
+
+DIMS = ModelDims(token_vocab_size=64, path_vocab_size=32,
+                 target_vocab_size=24, embeddings_size=8, max_contexts=6,
+                 tables_dtype="int8")
+
+
+def _batch(rng, b=16):
+    r = np.random.default_rng(rng)
+    return (jnp.asarray(r.integers(0, 24, b), jnp.int32),
+            jnp.asarray(r.integers(0, 64, (b, 6)), jnp.int32),
+            jnp.asarray(r.integers(0, 32, (b, 6)), jnp.int32),
+            jnp.asarray(r.integers(0, 64, (b, 6)), jnp.int32),
+            jnp.ones((b, 6), jnp.float32),
+            jnp.ones((b,), jnp.float32))
+
+
+def test_quantize_roundtrip_bound():
+    r = np.random.default_rng(0)
+    t = jnp.asarray(r.normal(size=(40, 8)) * 0.3, jnp.float32)
+    qt = quantize_table(t)
+    assert qt["q"].dtype == jnp.int8 and qt["s"].shape == (40, 1)
+    err = np.abs(np.asarray(dequantize_table(qt)) - np.asarray(t))
+    # per-row error bound: half a quantum
+    assert (err <= np.asarray(qt["s"]) / 2 + 1e-7).all()
+    # the absmax element of each row quantizes to exactly +-127
+    assert (np.abs(np.asarray(qt["q"])).max(axis=1) == 127).all()
+
+
+def test_quantized_take_grad_matches_float_reference():
+    r = np.random.default_rng(1)
+    t = jnp.asarray(r.normal(size=(32, 8)) * 0.2, jnp.float32)
+    qt = quantize_table(t)
+    deq = dequantize_table(qt)  # the exact values the int8 path sees
+    ids = jnp.asarray(r.integers(0, 32, (4, 6)), jnp.int32)
+    w = jnp.asarray(r.normal(size=(4, 6, 8)), jnp.float32)
+
+    def loss_q(carrier):
+        return jnp.sum(quantized_take(carrier, qt, ids) * w)
+
+    def loss_f(table):
+        return jnp.sum(jnp.take(table, ids, axis=0) * w)
+
+    g_carrier = jax.grad(loss_q)(jnp.zeros((32, 8), jnp.float32))
+    g_ref = jax.grad(loss_f)(deq)
+    np.testing.assert_allclose(np.asarray(g_carrier), np.asarray(g_ref),
+                               rtol=1e-6)
+    # forward value matches the dequantized gather
+    np.testing.assert_allclose(
+        np.asarray(quantized_take(jnp.zeros((32, 8)), qt, ids)),
+        np.asarray(jnp.take(deq, ids, axis=0)), rtol=1e-6)
+
+
+def test_requantize_untouched_rows_stable():
+    r = np.random.default_rng(2)
+    t = jnp.asarray(r.normal(size=(64, 8)) * 0.5, jnp.float32)
+    qt = quantize_table(t)
+    upd = np.zeros((64, 8), np.float32)
+    upd[3] = 0.01  # one touched row
+    out = requantize(qt, jnp.asarray(upd), jax.random.PRNGKey(0))
+    dq = np.asarray(qt["q"])
+    dq_new = np.asarray(out["q"])
+    untouched = [i for i in range(64) if i != 3]
+    # scale roundtrip is exact to 1 ulp -> at most a 1-quantum dither
+    # tail with ~1e-5 probability per element; on 63x8 elements expect
+    # bit-equality (assert a tiny tolerance, not luck)
+    flips = (dq_new[untouched] != dq[untouched]).sum()
+    assert flips <= 1
+    assert (np.abs(dq_new[untouched].astype(int)
+                   - dq[untouched].astype(int)) <= 1).all()
+    # the touched row actually moved toward the update
+    row_f = np.asarray(dequantize_table(out))[3]
+    target = np.asarray(dequantize_table(qt))[3] + upd[3]
+    assert np.abs(row_f - target).max() <= np.asarray(out["s"])[3, 0]
+
+
+def test_requantize_stochastic_rounding_unbiased():
+    # an update of 0.3 quanta must survive in expectation (deterministic
+    # rounding would drop it entirely)
+    V, E = 1, 256
+    q = jnp.full((V, E), 10, jnp.int8)
+    s = jnp.full((V, 1), 0.01, jnp.float32)
+    upd = jnp.full((V, E), 0.003, jnp.float32)  # 0.3 quanta
+    outs = [np.asarray(dequantize_table(
+        requantize({"q": q, "s": s}, upd, jax.random.PRNGKey(k)))).mean()
+            for k in range(8)]
+    mean_v = float(np.mean(outs))
+    # expected float value 0.1 + 0.003 = 0.103; deterministic rounding
+    # of a constant row would also land here via the rescale, so ALSO
+    # check per-element variation exists (the dither is real): with a
+    # constant row every element maps to q=127, so use the float mean
+    # bound plus a non-constant row check below
+    assert 0.1015 < mean_v < 0.1045, mean_v
+    # non-constant row: a sub-quantum update must survive in
+    # expectation where deterministic rounding would drop it
+    r = np.random.default_rng(3)
+    t = jnp.asarray(np.abs(r.normal(size=(1, 512))) * 0.1 + 0.01,
+                    jnp.float32)
+    qt = quantize_table(t)
+    base = np.asarray(dequantize_table(qt))
+    upd2 = jnp.full((1, 512), float(np.asarray(qt["s"])[0, 0]) * 0.3,
+                    jnp.float32)  # 0.3 quanta everywhere
+    deltas = [np.asarray(dequantize_table(
+        requantize(qt, upd2, jax.random.PRNGKey(100 + k)))).mean()
+        - base.mean() for k in range(8)]
+    mean_delta = float(np.mean(deltas))
+    expect = float(np.asarray(upd2).mean())
+    assert 0.5 * expect < mean_delta < 1.5 * expect, (mean_delta, expect)
+
+
+def test_init_params_int8_structure():
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    assert is_quantized(params["token_emb"])
+    assert is_quantized(params["path_emb"])
+    assert params["target_emb"].dtype == jnp.bfloat16
+    assert params["transform"].dtype == jnp.float32
+
+
+def test_take_rows_serving_path():
+    params = init_params(jax.random.PRNGKey(0), DIMS)
+    ids = jnp.asarray([[0, 1], [2, 3]])
+    rows = take_rows(params, "token_emb", ids)
+    ref = jnp.take(dequantize_table(params["token_emb"]), ids, axis=0)
+    np.testing.assert_allclose(np.asarray(rows), np.asarray(ref),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("embedding_optimizer", ["adafactor", "adam"])
+def test_quantized_train_step_learns(embedding_optimizer):
+    params = init_params(jax.random.PRNGKey(3), DIMS)
+    opt = make_optimizer(0.05, embedding_optimizer=embedding_optimizer)
+    # the flat optimizer view (jax_model._opt_param_view contract)
+    view = {k: (jnp.zeros(v["q"].shape, jnp.bfloat16)
+                if is_quantized(v) else v) for k, v in params.items()}
+    opt_state = opt.init(view)
+    step = make_train_step(DIMS, opt, use_sampled_softmax=False)
+    batch = _batch(7)
+    losses = []
+    rng = jax.random.PRNGKey(4)
+    for i in range(60):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state, batch, k)
+        losses.append(float(loss))
+    assert is_quantized(params["token_emb"])  # structure preserved
+    assert params["token_emb"]["q"].dtype == jnp.int8
+    # memorizing one small batch must drive the loss down hard
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_quantized_vs_bf16_step_close_at_start():
+    """First-step loss of the int8 model sits near the bf16 model's
+    (same seed): quantization noise is a small perturbation, not a
+    different model."""
+    dims_b = ModelDims(**{**DIMS.__dict__, "tables_dtype": "bfloat16"})
+    p_q = init_params(jax.random.PRNGKey(5), DIMS)
+    p_b = init_params(jax.random.PRNGKey(5), dims_b)
+    opt = make_optimizer(1e-3)
+    view = {k: (jnp.zeros(v["q"].shape, jnp.bfloat16)
+                if is_quantized(v) else v) for k, v in p_q.items()}
+    s_q = make_train_step(DIMS, opt)
+    s_b = make_train_step(dims_b, opt)
+    batch = _batch(11)
+    _, _, l_q = s_q(p_q, opt.init(view), batch, jax.random.PRNGKey(6))
+    _, _, l_b = s_b(p_b, opt.init(p_b), batch, jax.random.PRNGKey(6))
+    assert abs(float(l_q) - float(l_b)) < 0.15, (float(l_q), float(l_b))
+
+
+def test_int8_model_trains_and_roundtrips(tmp_path):
+    """End-to-end: Code2VecModel with --tables_dtype int8 trains on the
+    tiny dataset, quality lands near the bf16 run's, and the checkpoint
+    round-trips the quantized structure through the manifest."""
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from tests.helpers import build_tiny_dataset
+    from tests.test_model import tiny_config
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    dataset = build_tiny_dataset(str(data_dir), n_train=256,
+                                 n_val=32, n_test=64, max_contexts=16)
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = tiny_config(dataset, TABLES_DTYPE="int8",
+                      EMBEDDING_OPTIMIZER="adafactor",
+                      NUM_TRAIN_EPOCHS=6)
+    cfg.verify()
+    model = Code2VecModel(cfg)
+    assert is_quantized(model.params["token_emb"])
+    before = model.evaluate()
+    model.train()
+    after = model.evaluate()
+    assert after.loss < before.loss
+    assert after.subtoken_f1 > 0.5
+    model.save(ckpt_dir)
+
+    cfg2 = tiny_config(dataset)  # dtype comes from the checkpoint dims
+    cfg2.load_path = ckpt_dir
+    model2 = Code2VecModel(cfg2)
+    assert is_quantized(model2.params["token_emb"])
+    assert model2.dims.tables_dtype == "int8"
+    loaded = model2.evaluate()
+    assert loaded.topk_acc == pytest.approx(after.topk_acc)
+
+
+def test_int8_config_gates():
+    """verify() rejects the combinations the int8 path does not cover."""
+    from code2vec_tpu.config import Config
+
+    for bad in (dict(ENCODER_TYPE="transformer"),
+                dict(HEAD="varmisuse"),
+                dict(MESH_MODEL_AXIS=2),
+                dict(TRUST_RATIO=True)):
+        cfg = Config(TABLES_DTYPE="int8", **bad)
+        cfg.train_data_path = "x"
+        with pytest.raises(ValueError):
+            cfg.verify()
+
+
+def test_trust_ratio_scope_dense():
+    """scope='dense' trust-scales only the non-table branch: table
+    updates match plain adafactor exactly, dense updates differ
+    (VERDICT r4 item 8 — the sane LAMB form)."""
+    dims = ModelDims(token_vocab_size=32, path_vocab_size=16,
+                     target_vocab_size=12, embeddings_size=8,
+                     max_contexts=4, tables_dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), dims)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 0.01, p.dtype), params)
+    plain = make_optimizer(1e-3)
+    dense = make_optimizer(1e-3, trust_ratio=True,
+                           trust_ratio_scope="dense")
+    u1, _ = plain.update(grads, plain.init(params), params)
+    u2, _ = dense.update(grads, dense.init(params), params)
+    np.testing.assert_allclose(np.asarray(u1["token_emb"]),
+                               np.asarray(u2["token_emb"]), rtol=1e-6)
+    assert not np.allclose(np.asarray(u1["transform"]),
+                           np.asarray(u2["transform"]))
+    # adam branch has no table/dense split -> clean error
+    with pytest.raises(ValueError):
+        make_optimizer(1e-3, embedding_optimizer="adam",
+                       trust_ratio=True, trust_ratio_scope="dense")

@@ -147,9 +147,86 @@ def method_source(rng: random.Random, verb: str, adj: str,
 
 REDUNDANT_SUFFIXES = ("Src", "Buf", "Acc")  # one per cue position
 
+# --deep_tail mode's identifier alphabet. 40 syllables -> 40^k names of
+# k parts; deep_tail_name() encodes an integer index in little-endian
+# base-40, so names are distinct BY CONSTRUCTION (no rejection sampling,
+# any pool size) and subtoken-decompose into common short subtokens the
+# way real Java locals do (`tmpBufAcc` -> tmp|buf|acc).
+DT_SYLL = ["tmp", "buf", "acc", "cur", "aux", "raw", "alt", "seq",
+           "loc", "ref", "arg", "ctx", "mem", "reg", "idx", "ptr",
+           "len", "pos", "src", "dst", "obj", "rec", "seg", "blk",
+           "cnt", "val", "itm", "nod", "lnk", "key", "qty", "sum",
+           "avg", "tot", "rem", "div", "mul", "off", "cap", "dim"]
+
+
+def deep_tail_name(i: int) -> str:
+    """Distinct camelCase identifier for pool index `i` (injective:
+    standard little-endian base-len(DT_SYLL) digit sequences)."""
+    digits = []
+    n = i
+    while True:
+        digits.append(n % len(DT_SYLL))
+        n //= len(DT_SYLL)
+        if n == 0:
+            break
+    parts = [DT_SYLL[d] for d in digits]
+    return parts[0] + "".join(cap(p) for p in parts[1:])
+
+
+class DeepTailJunk:
+    """--deep_tail junk-identifier source (VERDICT r4 item 1: put the
+    rarity detector in the regime the paper claims it works in — a
+    java-large-shaped identifier pool with a deep Zipf tail).
+
+    Two disjoint index ranges of the deep_tail_name() space:
+      - a `zipf_head` of the first `head` names, drawn Zipf-weighted
+        (`zipf_per_method` draws/method) — the common/mid-frequency
+        junk mass every real corpus has;
+      - an unbounded FRESH iterator starting at index `head`
+        (`fresh_per_method` names/method, never reused) — every draw is
+        a corpus singleton, which is what makes the train-token
+        histogram's tail deep (~methods x fresh_per_method distinct
+        once-seen tokens). The iterator keeps advancing through
+        val/test generation, so held-out methods carry never-seen
+        (OOV-at-eval) junk exactly like unseen real code does.
+    """
+
+    def __init__(self, head: int, fresh_per_method: int,
+                 zipf_per_method: int):
+        self.head = head
+        self.fresh_per_method = fresh_per_method
+        self.zipf_per_method = zipf_per_method
+        self._next_fresh = head
+        self._zipf_w = [1.0 / (r + 10) for r in range(head)]
+
+    def names_for_method(self, rng: random.Random,
+                         forbidden=()) -> list:
+        # dedupe all draws against this method's other locals so the
+        # emitted class stays javac-valid (no duplicate declarations):
+        # rng.choices draws with replacement, fresh names at small
+        # --deep_tail_head are single-syllable words overlapping NOUNS,
+        # and the caller's forbidden set carries its other declarations
+        out = []
+        taken = set(forbidden)
+        while len(out) < self.fresh_per_method:
+            nm = deep_tail_name(self._next_fresh)
+            self._next_fresh += 1
+            if nm not in taken:
+                out.append(nm)
+                taken.add(nm)
+        if self.head:
+            for i in rng.choices(range(self.head), weights=self._zipf_w,
+                                 k=self.zipf_per_method):
+                nm = deep_tail_name(i)
+                if nm not in taken:
+                    out.append(nm)
+                    taken.add(nm)
+        return out
+
 
 def method_source_redundant(rng: random.Random, verb: str, adj: str,
-                            noun: str, k_cues: int) -> str:
+                            noun: str, k_cues: int,
+                            junk: DeepTailJunk = None) -> str:
     """--redundant_cues mode (VERDICT r4 item 6, the defense positive
     control): the label is carried by `k_cues` DISTINCT local variables,
     each individually label-identifying (cue_i = methodName+suffix_i, a
@@ -171,6 +248,21 @@ def method_source_redundant(rng: random.Random, verb: str, adj: str,
         lines.append(f"  int {cur} = {prev} * 2;")
     if rng.random() < 0.3:
         lines.append(f"  int {distract} = x - 1;")
+    if junk is not None:
+        # deep-tail junk locals, javac-valid placement before the
+        # return; each is a USED local (chained into a dead sum) so the
+        # extractor gives it multiple path contexts, like real code —
+        # a declared-but-unread local would surface in fewer contexts
+        # than the attack's rename target ever does. `forbidden` keeps
+        # a junk draw from colliding with ANY other declaration in this
+        # method (DT_SYLL composites overlap NOUNS words and the cue /
+        # sum locals on rare draws)
+        names = junk.names_for_method(
+            rng, forbidden=(distract, distract + "Sum", mname, *cues))
+        lines += [f"  int {nm} = x + {i};"
+                  for i, nm in enumerate(names)]
+        lines.append("  int " + distract + "Sum = "
+                     + " + ".join(names) + ";")
     lines.append(f"  return {cues[-1]};")
     lines.append("}")
     return "\n".join("  " + ln for ln in lines)
@@ -192,7 +284,25 @@ def main() -> None:
                          "label-identifying locals (defense positive "
                          "control; see method_source_redundant). "
                          "0 (default) keeps the original bodies")
+    ap.add_argument("--deep_tail_fresh", type=int, default=0,
+                    help="java-large-shaped identifier pool (detection "
+                         "regime, VERDICT r4 item 1): N never-reused "
+                         "singleton junk locals per method (the deep "
+                         "tail). Requires --redundant_cues")
+    ap.add_argument("--deep_tail_zipf", type=int, default=1,
+                    help="Zipf-weighted draws/method from the junk "
+                         "head pool (common junk mass); active only "
+                         "with --deep_tail_fresh")
+    ap.add_argument("--deep_tail_head", type=int, default=50_000,
+                    help="size of the Zipf-weighted junk head pool")
     args = ap.parse_args()
+    if args.deep_tail_fresh and not args.redundant_cues:
+        ap.error("--deep_tail_fresh requires --redundant_cues (the "
+                 "detection-regime corpus must not be single-token-"
+                 "determined, or no defense/detection can win)")
+    junk = (DeepTailJunk(args.deep_tail_head, args.deep_tail_fresh,
+                         args.deep_tail_zipf)
+            if args.deep_tail_fresh else None)
     rng = random.Random(args.seed)
     tail_pool = None
     if args.tail_names:
@@ -244,7 +354,7 @@ def main() -> None:
             for v, a, n in chosen:
                 if args.redundant_cues:
                     body.append(method_source_redundant(
-                        rng, v, a, n, args.redundant_cues))
+                        rng, v, a, n, args.redundant_cues, junk=junk))
                 else:
                     fields.add((a + cap(n)) if a else n)
                     body.append(method_source(rng, v, a, n,
@@ -260,6 +370,10 @@ def main() -> None:
         print(f"{split}: {written} methods in {file_idx} files")
     print(f"total: {total_written} methods, "
           f"{len(names)} distinct target names")
+    if junk is not None:
+        print(f"deep tail: {junk._next_fresh - junk.head} fresh "
+              f"singleton junk names + {junk.head} Zipf-head junk "
+              f"names across all splits")
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ VARIANTS = {
     "sampled-f32-adam": (True, "float32", "adam", "bag"),
     "sampled-bf16-adam": (True, "bfloat16", "adam", "bag"),
     "sampled-bf16-adafactor": (True, "bfloat16", "adafactor", "bag"),
+    "sampled-int8-adafactor": (True, "int8", "adafactor", "bag"),
     "sampled-bf16-xf2": (True, "bfloat16", "adam", "transformer"),
 }
 
@@ -44,7 +45,8 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
                 max_contexts: int = 200,
                 save_path: str = None,
                 warmup_steps: int = 0,
-                trust_ratio: bool = False) -> dict:
+                trust_ratio: bool = False,
+                trust_ratio_scope: str = "all") -> dict:
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
 
@@ -63,6 +65,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         LR_SCHEDULE=lr_schedule,
         LR_WARMUP_STEPS=warmup_steps,
         TRUST_RATIO=trust_ratio,
+        TRUST_RATIO_SCOPE=trust_ratio_scope,
         SEED=seed,
         USE_SAMPLED_SOFTMAX=use_sampled,
         NUM_SAMPLED_CLASSES=num_sampled,
@@ -96,6 +99,7 @@ def run_variant(name: str, data: str, epochs: int, batch: int,
         "lr_schedule": lr_schedule,
         "warmup_steps": warmup_steps,
         "trust_ratio": trust_ratio,
+        "trust_ratio_scope": trust_ratio_scope,
         "max_contexts": max_contexts,
         "steps": model.step_num,
         "train_seconds": round(train_s, 1),
@@ -128,6 +132,10 @@ def main() -> None:
                     help="warmup_cosine warmup length (0 = auto 5%%)")
     ap.add_argument("--trust_ratio", action="store_true",
                     help="LAMB-style per-array trust ratio")
+    ap.add_argument("--trust_ratio_scope", default="all",
+                    choices=["all", "dense"],
+                    help="'dense' = trust-scale non-table params only "
+                         "(the sane LAMB form; VERDICT r4 item 8)")
     ap.add_argument("--num_sampled", type=int, default=1024)
     ap.add_argument("--max_contexts", type=int, default=200,
                     help="match the dataset's binarized width (200 for "
@@ -150,7 +158,8 @@ def main() -> None:
                         save_path=(args.save + "." + name.strip()
                                    if args.save else None),
                         warmup_steps=args.warmup_steps,
-                        trust_ratio=args.trust_ratio)
+                        trust_ratio=args.trust_ratio,
+                        trust_ratio_scope=args.trust_ratio_scope)
         results.append(r)
         if args.out:
             with open(args.out, "a") as f:

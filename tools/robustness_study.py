@@ -35,7 +35,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def run_arm(name: str, data: str, epochs: int, batch: int,
             adv_prob: float, n_attacks: int, max_renames: int,
             seed: int, max_contexts: int, detect: bool = False,
-            adv_mode: str = "uniform") -> dict:
+            adv_mode: str = "uniform", tag: str = "",
+            word_vocab_size: int = 150_000,
+            path_vocab_size: int = 150_000,
+            target_vocab_size: int = 60_000,
+            infeed_chunk: int = 1) -> dict:
     from code2vec_tpu.attacks.robustness import evaluate_robustness
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
@@ -43,9 +47,10 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
     # the shipped java-large-style config (sampled + bf16 + adafactor)
     cfg = Config(
         MAX_CONTEXTS=max_contexts,
-        MAX_TOKEN_VOCAB_SIZE=150_000,
-        MAX_PATH_VOCAB_SIZE=150_000,
-        MAX_TARGET_VOCAB_SIZE=60_000,
+        MAX_TOKEN_VOCAB_SIZE=word_vocab_size,
+        MAX_PATH_VOCAB_SIZE=path_vocab_size,
+        MAX_TARGET_VOCAB_SIZE=target_vocab_size,
+        INFEED_CHUNK=infeed_chunk,
         TRAIN_BATCH_SIZE=batch,
         TEST_BATCH_SIZE=batch,
         NUM_TRAIN_EPOCHS=epochs,
@@ -76,6 +81,8 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
                               detector=detector, log=cfg.log)
     row = {
         "arm": name,
+        "tag": tag,
+        "word_vocab_size": model.vocabs.token_vocab.size,
         "adv_rename_prob": adv_prob,
         "adv_rename_mode": adv_mode if adv_prob > 0 else "-",
         "epochs": epochs,
@@ -87,7 +94,8 @@ def run_arm(name: str, data: str, epochs: int, batch: int,
         "n_attacks": rob["n_methods"],
         "train_seconds": round(train_s, 1),
     }
-    for key in ("detection_auc", "detection_tpr_at_5fpr"):
+    for key in ("detection_auc", "detection_tpr_at_5fpr",
+                "replacement_token_freq", "original_token_freq"):
         if key in rob:
             row[key] = rob[key]
     print(json.dumps(row), flush=True)
@@ -114,6 +122,20 @@ def main() -> int:
     ap.add_argument("--detect", action="store_true",
                     help="also measure rarity-outlier detection "
                          "(attacks/detect.py) on the attacked methods")
+    ap.add_argument("--word_vocab_size", type=int, default=150_000,
+                    help="token vocab cap — the detection-regime study "
+                         "(deep-tail corpus) needs ~800K so the "
+                         "singleton tail stays IN vocab")
+    ap.add_argument("--path_vocab_size", type=int, default=150_000)
+    ap.add_argument("--target_vocab_size", type=int, default=60_000)
+    ap.add_argument("--infeed_chunk", type=int, default=1,
+                    help="latency-chunked infeed group size (speeds "
+                         "training on the tunneled dev link)")
+    ap.add_argument("--tag", default="",
+                    help="free-form row label (e.g. the corpus's cue "
+                         "redundancy k in the defense grid)")
+    ap.add_argument("--out", default=None,
+                    help="append JSON rows here too")
     a = ap.parse_args()
 
     arms = [s.strip() for s in a.arms.split(",")]
@@ -123,10 +145,18 @@ def main() -> int:
     rows = []
     for arm in arms:
         prob = 0.0 if arm == "baseline" else a.adv_prob
-        rows.append(run_arm(arm, a.data, a.epochs, a.batch, prob,
-                            a.n_attacks, a.max_renames, a.seed,
-                            a.max_contexts, detect=a.detect,
-                            adv_mode=a.adv_mode))
+        row = run_arm(arm, a.data, a.epochs, a.batch, prob,
+                      a.n_attacks, a.max_renames, a.seed,
+                      a.max_contexts, detect=a.detect,
+                      adv_mode=a.adv_mode, tag=a.tag,
+                      word_vocab_size=a.word_vocab_size,
+                      path_vocab_size=a.path_vocab_size,
+                      target_vocab_size=a.target_vocab_size,
+                      infeed_chunk=a.infeed_chunk)
+        rows.append(row)
+        if a.out:
+            with open(a.out, "a") as f:
+                f.write(json.dumps(row) + "\n")
     print(f"\n{'arm':<10} {'p':>4} {'cleanF1':>8} {'top1':>6} "
           f"{'atk-success':>11} {'atk-top1':>8}")
     for r in rows:
