@@ -255,3 +255,19 @@ def test_trust_ratio_scope_dense():
     with pytest.raises(ValueError):
         make_optimizer(1e-3, embedding_optimizer="adam",
                        trust_ratio=True, trust_ratio_scope="dense")
+
+
+def test_dither_is_uniform_enough():
+    """The counter-hash dither must behave like U(-0.5, 0.5): bounded,
+    near-zero mean, ~1/12 variance, and decorrelated across salts."""
+    from code2vec_tpu.ops.quant import _dither
+    d1 = np.asarray(_dither(jax.random.PRNGKey(0), (512, 128)))
+    d2 = np.asarray(_dither(jax.random.PRNGKey(1), (512, 128)))
+    assert d1.min() >= -0.5 and d1.max() < 0.5
+    assert abs(d1.mean()) < 0.005
+    assert abs(d1.var() - 1.0 / 12.0) < 0.005
+    # different step salts -> different streams
+    assert np.abs(np.corrcoef(d1.ravel(), d2.ravel())[0, 1]) < 0.02
+    # adjacent elements are not visibly correlated within one stream
+    assert np.abs(np.corrcoef(d1.ravel()[:-1], d1.ravel()[1:])[0, 1]) \
+        < 0.02
