@@ -135,8 +135,10 @@ def take_rows(params: Params, name: str, ids: jax.Array) -> jax.Array:
         if "g" in t:
             from code2vec_tpu.ops.quant import quantized_take
             return quantized_take(t["g"], t, ids)
-        return (jnp.take(t["q"], ids, axis=0).astype(t["s"].dtype)
-                * jnp.take(t["s"], ids, axis=0))
+        # bf16 output, matching quantized_take (int8 rows carry <= 8
+        # significant bits; f32 would double the activation traffic)
+        return (jnp.take(t["q"], ids, axis=0).astype(jnp.float32)
+                * jnp.take(t["s"], ids, axis=0)).astype(jnp.bfloat16)
     return jnp.take(t, ids, axis=0)
 
 

@@ -61,12 +61,15 @@ def test_quantized_take_grad_matches_float_reference():
 
     g_carrier = jax.grad(loss_q)(jnp.zeros((32, 8), jnp.float32))
     g_ref = jax.grad(loss_f)(deq)
+    # qtake emits bf16 (by design — see ops/quant.py), so the gradient
+    # and forward agree with the f32 reference to bf16 precision
     np.testing.assert_allclose(np.asarray(g_carrier), np.asarray(g_ref),
-                               rtol=1e-6)
-    # forward value matches the dequantized gather
+                               rtol=1e-2, atol=1e-3)
     np.testing.assert_allclose(
-        np.asarray(quantized_take(jnp.zeros((32, 8)), qt, ids)),
-        np.asarray(jnp.take(deq, ids, axis=0)), rtol=1e-6)
+        np.asarray(quantized_take(jnp.zeros((32, 8)), qt, ids),
+                   dtype=np.float32),
+        np.asarray(jnp.take(deq.astype(jnp.bfloat16), ids, axis=0),
+                   dtype=np.float32), rtol=1e-6)
 
 
 def test_requantize_untouched_rows_stable():
@@ -138,9 +141,10 @@ def test_take_rows_serving_path():
     params = init_params(jax.random.PRNGKey(0), DIMS)
     ids = jnp.asarray([[0, 1], [2, 3]])
     rows = take_rows(params, "token_emb", ids)
+    assert rows.dtype == jnp.bfloat16  # half-width activation contract
     ref = jnp.take(dequantize_table(params["token_emb"]), ids, axis=0)
-    np.testing.assert_allclose(np.asarray(rows), np.asarray(ref),
-                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rows, dtype=np.float32),
+                               np.asarray(ref), rtol=1e-2, atol=1e-3)
 
 
 @pytest.mark.parametrize("embedding_optimizer", ["adafactor", "adam"])

@@ -53,3 +53,18 @@ def time_fn(fn, args, steps: int, sync=None):
         return time.perf_counter() - t0, None
 
     return slope_time(chain, None, steps)
+
+
+def load_bench_module():
+    """Import repo-root bench.py as a module (it is the standalone
+    driver artifact, not a package member). Shared by the tools that
+    reuse its measurement entry points (c_sweep_step, int8_profile) so
+    the loader does not fan out per tool."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

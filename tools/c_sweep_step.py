@@ -22,22 +22,12 @@ Prints one JSON line per C and a summary.
 from __future__ import annotations
 
 import argparse
-import importlib.util
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-
-
-def _load_bench():
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def main() -> None:
@@ -47,7 +37,8 @@ def main() -> None:
                     choices=["bfloat16", "int8"])
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
-    bench = _load_bench()
+    from tools._bench_common import load_bench_module
+    bench = load_bench_module()
 
     rows = []
     for c in (int(s) for s in args.contexts.split(",")):
