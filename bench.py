@@ -21,6 +21,13 @@ Extra keys:
     gains need less *traffic*, not better overlap.
   - transformer_*: the same measurement for --encoder transformer
     (xf_layers=2), the BASELINE.json configs[4] stretch encoder.
+  - int8_*: the sub-bf16 memory-lever config (ops/quant.py), with the
+    requantize phase attributed every round: int8_requant_ms (the
+    apply alone, fused Pallas row-pass on TPU), int8_requant_bytes
+    (analytic bytes of ONE fused sweep), int8_requant_gbps achieved vs
+    int8_requant_floor_ms (= bytes / streaming ceiling — the phase at
+    its roofline). int8_hbm_gbps uses the quantized-carrier-aware
+    traffic model (bf16 [V, E] grad carrier + int8 q / f32 s r+w).
 
 Baseline denominator: derived, methodology-documented single-V100
 estimate of the reference step (fp32, full softmax, dense Adam, input
@@ -62,17 +69,32 @@ def _step_hbm_bytes(params, opt_state) -> int:
                 param dtype under value_and_grad);
       optimizer: grads read, params read + written, every optimizer-state
                 leaf read + written (Adam: 2 full-table f32 moments;
-                adafactor: factored row/col stats, ~V+E per table).
+                adafactor: factored row/col stats, ~V+E per table);
+      quantized {q, s} subtrees (tables_dtype int8): the table gradient
+                is a bf16 [V, E] CARRIER (ops/quant.py straight-through
+                custom_vjp), not an int8 array, so the grad term counts
+                2 bytes/elt; the param term is the requantize pass's
+                int8 q + f32 s read + write. Sizing the grad by the
+                stored dtype undercounted int8 2x (ADVICE r5 finding 2).
 
     Gathers/activations (~0.3 GB at B=1024, and running at random-access
     bandwidth, not streaming) are excluded — this is a lower bound, so
     achieved GB/s derived from it is conservative."""
     import jax
 
+    from code2vec_tpu.ops.quant import is_quantized
+
     total = 0
-    for p in jax.tree_util.tree_leaves(params):
-        b = p.size * p.dtype.itemsize
-        total += b * 4  # grad write + grad read + param read + write
+    for p in params.values():
+        if is_quantized(p):
+            total += p["q"].size * 2 * 2  # bf16 carrier grad write+read
+            total += p["q"].size * p["q"].dtype.itemsize * 2  # q r+w
+            total += p["s"].size * p["s"].dtype.itemsize * 2  # s r+w
+            continue
+        # plain leaves — including nested subtrees (transformer "xf")
+        for leaf in jax.tree_util.tree_leaves(p):
+            b = leaf.size * leaf.dtype.itemsize
+            total += b * 4  # grad write + grad read + param read + write
     for s in jax.tree_util.tree_leaves(opt_state):
         total += s.size * s.dtype.itemsize * 2  # state read + write
     return total
@@ -175,6 +197,54 @@ def _measure_fwd_bwd_floor():
     return BATCH * MAX_CONTEXTS / dt
 
 
+def _measure_requant_phase():
+    """Slope-time the int8 requantize apply ALONE over the two
+    quantized tables (the fused Pallas row-pass on TPU, the XLA
+    reference elsewhere — ops/quant.requantize's auto-select, i.e. the
+    exact code the train step runs) plus the analytic bytes one fused
+    sweep must move, so the phase is attributed against the streaming
+    ceiling every round instead of once per profiling session
+    (VERDICT r5 weak #2). Returns (ms, bytes, fused?)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.models.encoder import init_params
+    from code2vec_tpu.ops.pallas_requant import requant_traffic_bytes
+    from code2vec_tpu.ops.quant import is_quantized, requantize
+
+    dims = _java_large_dims("bag", tables_dtype="int8")
+    params = init_params(jax.random.PRNGKey(0), dims)
+    qkeys = sorted(k for k in params if is_quantized(params[k]))
+    # the optimizer's table output is a bf16 [V, E] update (carrier
+    # grads are bf16); a fixed sub-quantum magnitude keeps q stable
+    updates = {k: jnp.full(params[k]["q"].shape, 1e-5, jnp.bfloat16)
+               for k in qkeys}
+    nbytes = sum(requant_traffic_bytes(params[k], updates[k])
+                 for k in qkeys)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def apply(tables, rng):
+        rng, *qrngs = jax.random.split(rng, 1 + len(qkeys))
+        new = {k: requantize(tables[k], updates[k], r)
+               for k, r in zip(qkeys, qrngs)}
+        return new, rng
+
+    def chain(n, state):
+        tables, rng = state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tables, rng = apply(tables, rng)
+        # hard sync via a scalar host transfer (slope-timing contract)
+        float(tables[qkeys[0]]["s"].ravel()[0])
+        return time.perf_counter() - t0, (tables, rng)
+
+    tables0 = {k: params[k] for k in qkeys}
+    dt = max(_slope_time(chain, (tables0, jax.random.PRNGKey(7))), 1e-9)
+    return dt * 1e3, nbytes, jax.default_backend() == "tpu"
+
+
 def _measure_encoder(encoder_type: str, tables_dtype: str = "bfloat16",
                      max_contexts: int = MAX_CONTEXTS):
     """Build the shipped train step for one encoder and time it.
@@ -226,7 +296,9 @@ def main() -> None:
     ceiling = _measure_hbm_ceiling()
     value, ms, hbm_gbps = _measure_encoder("bag")
     floor = _measure_fwd_bwd_floor()
-    i8_value, i8_ms, _ = _measure_encoder("bag", tables_dtype="int8")
+    i8_value, i8_ms, i8_hbm = _measure_encoder("bag", tables_dtype="int8")
+    rq_ms, rq_bytes, rq_fused = _measure_requant_phase()
+    rq_gbps = rq_bytes / (rq_ms / 1e3) / 1e9
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
     print(json.dumps({
         "metric": "path-contexts/sec/chip",
@@ -259,6 +331,18 @@ def main() -> None:
         "int8_ms_per_step": round(i8_ms, 2),
         "int8_vs_baseline": round(
             i8_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
+        # int8 analytic-traffic bandwidth (quantized-carrier-aware
+        # _step_hbm_bytes) + the requantize phase attributed against
+        # the streaming ceiling: requant_ms at the floor (_floor_ms =
+        # one fused sweep's bytes / ceiling) means the memory lever is
+        # speed-neutral; the round-5 unfused phase ran ~9.7 ms
+        "int8_hbm_gbps": round(i8_hbm, 1),
+        "int8_requant_ms": round(rq_ms, 3),
+        "int8_requant_bytes": int(rq_bytes),
+        "int8_requant_gbps": round(rq_gbps, 1),
+        "int8_requant_floor_ms": round(rq_bytes / ceiling * 1e3, 3),
+        "int8_requant_vs_ceiling": round(rq_gbps / (ceiling / 1e9), 3),
+        "int8_requant_fused": rq_fused,
         "transformer_pc_per_sec": round(xf_value, 1),
         "transformer_ms_per_step": round(xf_ms, 2),
         "transformer_hbm_gbps": round(xf_hbm, 1),

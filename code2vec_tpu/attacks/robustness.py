@@ -30,6 +30,35 @@ from code2vec_tpu.attacks.gradient_attack import GradientRenameAttack
 from code2vec_tpu.data.reader import parse_c2v_rows
 
 
+def _freq_stats(words, counts, token_vocab) -> dict:
+    """Training-frequency stats of `words` under the detector vocab's
+    per-row `counts`. Words the vocab maps to its OOV index would
+    silently contribute the OOV row's train count (typically 0) —
+    skewing frac_singleton and the rank percentile upward — so they
+    are EXCLUDED and reported as n_oov_excluded instead (ADVICE r5
+    finding 3)."""
+    oov = token_vocab.oov_index
+    idxs = [token_vocab.lookup_index(w) for w in words]
+    kept = [i for i in idxs if i != oov]
+    n_excluded = len(idxs) - len(kept)
+    c = np.asarray([int(counts[i]) for i in kept], np.int64)
+    if not len(c):
+        return {"n": 0, "n_oov_excluded": n_excluded}
+    counts = np.asarray(counts)
+    nz = np.sort(counts[counts > 0])
+    # fraction of in-vocab tokens strictly more common than each chosen
+    # token: 0.0 = the most common token, ~1.0 = a deep-tail singleton
+    rank_pct = 1.0 - np.searchsorted(nz, c, side="right") / len(nz)
+    return {
+        "n": len(c),
+        "n_oov_excluded": n_excluded,
+        "median_train_count": float(np.median(c)),
+        "p90_train_count": float(np.quantile(c, 0.9)),
+        "frac_singleton": round(float(np.mean(c <= 2)), 4),
+        "median_rank_pct": round(float(np.median(rank_pct)), 4),
+    }
+
+
 def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
                         max_renames: int = 1, max_iters: int = 4,
                         top_k_candidates: int = 32,
@@ -140,29 +169,13 @@ def evaluate_robustness(model, test_path: str, *, n_methods: int = 200,
         # RARE replacement names. Measure which regime this sweep is
         # actually in by looking up every successful rename's
         # replacement (and, as the baseline, the original attacked
-        # token) in the training histogram.
-        def _freq_stats(words):
-            # index through the DETECTOR's vocab: detector.counts is
-            # aligned to the vocab the detector was built from
-            tv = detector.token_vocab
-            c = np.asarray([int(detector.counts[tv.lookup_index(w)])
-                            for w in words], np.int64)
-            if not len(c):
-                return {"n": 0}
-            nz = np.sort(detector.counts[detector.counts > 0])
-            # fraction of in-vocab tokens strictly more common than
-            # each chosen token: 0.0 = the most common token, ~1.0 = a
-            # deep-tail singleton
-            rank_pct = 1.0 - np.searchsorted(nz, c, side="right") / len(nz)
-            return {
-                "n": len(c),
-                "median_train_count": float(np.median(c)),
-                "p90_train_count": float(np.quantile(c, 0.9)),
-                "frac_singleton": round(float(np.mean(c <= 2)), 4),
-                "median_rank_pct": round(float(np.median(rank_pct)), 4),
-            }
-        report["replacement_token_freq"] = _freq_stats(replacement_words)
-        report["original_token_freq"] = _freq_stats(original_words)
+        # token) in the training histogram — indexed through the
+        # DETECTOR's vocab (detector.counts is aligned to it), with
+        # OOV-mapped words excluded rather than miscounted.
+        report["replacement_token_freq"] = _freq_stats(
+            replacement_words, detector.counts, detector.token_vocab)
+        report["original_token_freq"] = _freq_stats(
+            original_words, detector.counts, detector.token_vocab)
     return report
 
 

@@ -108,6 +108,13 @@ class Config:
     # at B=1024). Default on; it only takes effect on a TPU backend
     # (the model silently falls back to the XLA pool elsewhere).
     USE_PALLAS: bool = True
+    # int8 requantize implementation (only meaningful with
+    # --tables_dtype int8): "auto" = the fused Pallas row-pass
+    # (ops/pallas_requant.py) on TPU, the multi-pass XLA reference
+    # elsewhere; "fused" forces the kernel (interpret mode off-TPU —
+    # the CPU test path); "reference" forces the multi-pass form
+    # (the round-5 baseline, kept for A/B attribution).
+    REQUANT_PALLAS: str = "auto"  # "auto" | "fused" | "reference"
     # Double-buffered device infeed (data/prefetch.py; SURVEY.md §3.3
     # infeed row): how many batches ahead a daemon thread runs the host
     # parse + host->device transfer. 2 = classic double buffering
@@ -342,6 +349,12 @@ class Config:
                        choices=["float32", "bfloat16", "int8"])
         p.add_argument("--embedding_optimizer", dest="embedding_optimizer",
                        default=None, choices=["adam", "adafactor"])
+        p.add_argument("--requant_pallas", dest="requant_pallas",
+                       default=None,
+                       choices=["auto", "fused", "reference"],
+                       help="int8 requantize implementation: fused "
+                            "Pallas row-pass (auto on TPU) or the "
+                            "multi-pass XLA reference")
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--mesh_context", dest="mesh_context", type=int,
@@ -464,6 +477,8 @@ class Config:
             cfg.TABLES_DTYPE = ns.tables_dtype
         if ns.embedding_optimizer is not None:
             cfg.EMBEDDING_OPTIMIZER = ns.embedding_optimizer
+        if ns.requant_pallas is not None:
+            cfg.REQUANT_PALLAS = ns.requant_pallas
         if ns.mesh_data is not None:
             cfg.MESH_DATA_AXIS = ns.mesh_data
         if ns.mesh_model is not None:
@@ -547,6 +562,10 @@ class Config:
             raise ValueError(
                 "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
                 "the adam embedding optimizer.")
+        if self.REQUANT_PALLAS not in ("auto", "fused", "reference"):
+            raise ValueError(
+                "--requant_pallas must be auto, fused or reference "
+                f"(got {self.REQUANT_PALLAS!r}).")
         if self.TABLES_DTYPE == "int8":
             # the int8 path covers the shipped per-chip training config
             # (bag encoder, single device); the gated combinations read

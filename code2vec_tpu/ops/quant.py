@@ -37,7 +37,11 @@ TPU-first design notes:
   drop most updates and the tables would never train (the bf16
   freeze effect, BASELINE.md decay study, at 8x the magnitude).
   Uniform-dither rounding keeps the applied update correct in
-  expectation. Untouched rows (update == 0) requantize stably: a
+  expectation. On TPU the whole update runs as ONE fused Pallas
+  row-pass (ops/pallas_requant.py, round 6 — the multi-pass XLA form
+  below re-streams the f32 table and cost +6.7 ms/step, BASELINE.md
+  round 5); `requantize` dispatches between them.
+  Untouched rows (update == 0) requantize stably: a
   freshly quantized row's absmax element is ±127, so the recomputed
   scale reproduces the old one to 1 ulp and round(q + eps + u) == q
   except on a ~1e-5-probability dither tail — no systematic drift
@@ -166,10 +170,16 @@ def _dither(rng: jax.Array, shape) -> jax.Array:
             - 0.5)
 
 
-def requantize(qt: QuantTable, update: jax.Array,
-               rng: jax.Array) -> QuantTable:
+def requantize_reference(qt: QuantTable, update: jax.Array,
+                         rng: jax.Array) -> QuantTable:
     """Apply a dense [V, E] additive update to a quantized table with
-    stochastic rounding; per-row scales track the new absmax."""
+    stochastic rounding; per-row scales track the new absmax.
+
+    This is the multi-pass XLA form (it materializes the dequantized
+    f32 table and streams it several times — BASELINE.md round-5 pins
+    +6.7 ms of the int8 step regression on exactly that); it stays as
+    the parity oracle for the fused Pallas row-pass and as the CPU
+    default, where XLA's fusion beats the interpreted kernel."""
     f = qt["q"].astype(jnp.float32) * qt["s"] + update.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(f), axis=1, keepdims=True)
     s_new = jnp.maximum(absmax, _SCALE_FLOOR) / 127.0
@@ -177,3 +187,32 @@ def requantize(qt: QuantTable, update: jax.Array,
     q_new = jnp.clip(jnp.round(x + _dither(rng, f.shape)),
                      -127, 127).astype(jnp.int8)
     return {"q": q_new, "s": s_new}
+
+
+def requantize(qt: QuantTable, update: jax.Array, rng: jax.Array, *,
+               fused: bool = None) -> QuantTable:
+    """The table-update entry point the quantized train step calls.
+    `fused=None` (the default) auto-selects the fused Pallas row-pass
+    (ops/pallas_requant.py) on a TPU backend and the multi-pass XLA
+    reference elsewhere; True forces the kernel (interpret mode
+    off-TPU — how the CPU tier-1 tests drive it), False forces the
+    reference. Config.REQUANT_PALLAS maps onto this via
+    resolve_requant_mode."""
+    if fused is None:
+        fused = jax.default_backend() == "tpu"
+    if fused:
+        from code2vec_tpu.ops.pallas_requant import requantize_fused
+        return requantize_fused(qt, update, rng)
+    return requantize_reference(qt, update, rng)
+
+
+def resolve_requant_mode(mode: str):
+    """Config.REQUANT_PALLAS -> the `fused` argument of requantize():
+    "auto" -> None (backend auto-select), "fused" -> True,
+    "reference" -> False. Config.verify() rejects anything else; this
+    raises for programmatic users bypassing verify()."""
+    try:
+        return {"auto": None, "fused": True, "reference": False}[mode]
+    except KeyError:
+        raise ValueError(
+            f"REQUANT_PALLAS must be auto|fused|reference, got {mode!r}")

@@ -359,6 +359,29 @@ def test_rarity_detector_flags_attacks(trained):
     assert auc(np.array([3.0, 4.0]), np.array([1.0, 2.0])) == 0.0
 
 
+def test_freq_stats_excludes_oov_words():
+    """The replacement-frequency report must not let OOV-mapped words
+    contribute the OOV row's (typically zero) train count — they are
+    excluded and counted separately (ADVICE r5 finding 3)."""
+    from code2vec_tpu.attacks.robustness import _freq_stats
+    from code2vec_tpu.vocab.vocabularies import Vocab, VocabType
+
+    v = Vocab(VocabType.Token, ["alpha", "beta", "gamma"])
+    counts = np.zeros((8,), np.int64)
+    counts[v.lookup_index("alpha")] = 100
+    counts[v.lookup_index("beta")] = 1
+    counts[v.lookup_index("gamma")] = 50
+    stats = _freq_stats(["alpha", "notInVocabXyz", "beta"], counts, v)
+    assert stats["n"] == 2 and stats["n_oov_excluded"] == 1
+    # without the filter the OOV word's count-0 row would have dragged
+    # the median to 1 and pushed frac_singleton to 2/3
+    assert stats["median_train_count"] == 50.5
+    assert stats["frac_singleton"] == 0.5
+    # all-OOV input: no stats rows, the exclusion count still reported
+    assert _freq_stats(["q1", "q2"], counts, v) == \
+        {"n": 0, "n_oov_excluded": 2}
+
+
 def test_rarity_detector_scores_rare_attention_higher(trained):
     import jax.numpy as jnp
     from code2vec_tpu.attacks.detect import (RarityDetector,

@@ -45,10 +45,49 @@ def test_main_emits_one_valid_json_line(monkeypatch, capsys):
     for key in ("metric", "value", "unit", "vs_baseline", "ms_per_step",
                 "hbm_gbps", "hbm_ceiling_gbps",
                 "fwd_bwd_floor_pc_per_sec", "optimizer_efficiency",
-                "transformer_pc_per_sec"):
+                "transformer_pc_per_sec",
+                # int8 requantize phase attribution (round 6): the
+                # acceptance contract is these fields present off-TPU
+                "int8_hbm_gbps", "int8_requant_ms", "int8_requant_bytes",
+                "int8_requant_gbps", "int8_requant_floor_ms",
+                "int8_requant_vs_ceiling", "int8_requant_fused"):
         assert key in j, key
     assert j["metric"] == "path-contexts/sec/chip"
     assert np.isfinite(j["value"])
+    assert j["int8_requant_fused"] is False  # CPU -> reference path
+    assert j["int8_requant_bytes"] > 0
+
+
+def test_step_hbm_bytes_counts_quantized_carrier():
+    """int8 subtrees: the analytic grad term must size the bf16 [V, E]
+    carrier (2 B/elt), not the stored int8 (1 B/elt), and the param
+    term the q/s read+write (ADVICE r5 finding 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.models.encoder import ModelDims, init_params
+    from code2vec_tpu.ops.quant import is_quantized
+
+    dims = ModelDims(token_vocab_size=64, path_vocab_size=32,
+                     target_vocab_size=24, embeddings_size=8,
+                     max_contexts=6, tables_dtype="int8")
+    params = init_params(jax.random.PRNGKey(0), dims)
+    opt_state = {"nu": jnp.zeros((3, 4), jnp.float32)}
+    expected = opt_state["nu"].size * 4 * 2
+    for p in params.values():
+        if is_quantized(p):
+            expected += (p["q"].size * 2 * 2          # bf16 carrier r+w
+                         + p["q"].size * 1 * 2        # int8 q r+w
+                         + p["s"].size * 4 * 2)       # f32 s r+w
+        else:
+            expected += p.size * p.dtype.itemsize * 4
+    assert bench._step_hbm_bytes(params, opt_state) == expected
+    # regression guard for the original bug: the quantized accounting
+    # must exceed stored-dtype sizing (1 B grads) for the same params
+    naive = sum(x.size * x.dtype.itemsize * 4
+                for x in jax.tree_util.tree_leaves(params)) \
+        + opt_state["nu"].size * 4 * 2
+    assert bench._step_hbm_bytes(params, opt_state) > naive
 
 
 def test_graft_entry_forward_compiles():

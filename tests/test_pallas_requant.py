@@ -1,0 +1,174 @@
+"""Fused Pallas requantize row-pass tests (ops/pallas_requant.py).
+
+Covers: interpret-mode parity against the multi-pass XLA reference
+(q bit-exact under the shared counter-hash dither stream), the
+dither-mean statistical property through the kernel, untouched-row
+stability through the kernel, the requantize dispatch + config
+resolution, and an int8 tiny-model train smoke that goes through the
+fused path — all on the CPU interpreter (tier-1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.encoder import ModelDims, init_params
+from code2vec_tpu.ops.pallas_requant import (requant_traffic_bytes,
+                                             requantize_fused)
+from code2vec_tpu.ops.quant import (dequantize_table, is_quantized,
+                                    opt_param_view, quantize_table,
+                                    requantize, requantize_reference,
+                                    resolve_requant_mode)
+from code2vec_tpu.training.optimizers import make_optimizer
+from code2vec_tpu.training.steps import make_train_step
+
+DIMS = ModelDims(token_vocab_size=64, path_vocab_size=32,
+                 target_vocab_size=24, embeddings_size=8, max_contexts=6,
+                 tables_dtype="int8")
+
+
+def _case(V, E, upd_scale=0.005, upd_dtype=jnp.float32):
+    r = np.random.default_rng(V)
+    t = jnp.asarray(r.normal(size=(V, E)) * 0.3, jnp.float32)
+    qt = quantize_table(t)
+    upd = jnp.asarray(r.normal(size=(V, E)) * upd_scale, upd_dtype)
+    return qt, upd
+
+
+# shapes cover: multi-block, non-multiple-of-block V, single padded
+# block, E > lane width, and a 1-row table
+@pytest.mark.parametrize("V,E", [(64, 8), (40, 16), (300, 128), (5, 8),
+                                 (1, 256)])
+@pytest.mark.parametrize("upd_dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_matches_reference(V, E, upd_dtype):
+    """The kernel IS the reference, restructured: same rng -> same salt
+    -> same counter-hash dither stream -> q bit-exact. s agrees to
+    <= 2 ulp (float-contraction/FMA ordering differs between the
+    interpreted kernel and the fused XLA reference; q absorbs the last
+    ulp in its integer rounding)."""
+    qt, upd = _case(V, E, upd_dtype=upd_dtype)
+    rng = jax.random.PRNGKey(9)
+    ref = requantize_reference(qt, upd, rng)
+    out = requantize_fused(qt, upd, rng, block_rows=32)
+    assert out["q"].dtype == jnp.int8 and out["s"].shape == (V, 1)
+    np.testing.assert_array_equal(np.asarray(ref["q"]),
+                                  np.asarray(out["q"]))
+    ulp = np.abs(np.asarray(ref["s"]).ravel().view(np.int32)
+                 - np.asarray(out["s"]).ravel().view(np.int32))
+    assert ulp.max() <= 2, ulp.max()
+
+
+def test_fused_default_block_rows_and_jit():
+    """The production call shape: default block size (table smaller
+    than one block -> fully padded grid), invoked inside an outer jit
+    like the train step does."""
+    qt, upd = _case(48, 8)
+    rng = jax.random.PRNGKey(2)
+    out = jax.jit(lambda q, u, r: requantize_fused(q, u, r))(qt, upd, rng)
+    ref = requantize_reference(qt, upd, rng)
+    np.testing.assert_array_equal(np.asarray(ref["q"]),
+                                  np.asarray(out["q"]))
+
+
+def test_requantize_dispatch_and_mode_resolution():
+    """requantize() auto-selects the reference off-TPU, forces the
+    kernel under fused=True; resolve_requant_mode maps the config
+    strings onto exactly those arguments."""
+    qt, upd = _case(32, 8)
+    rng = jax.random.PRNGKey(4)
+    auto = requantize(qt, upd, rng)  # CPU -> reference
+    ref = requantize_reference(qt, upd, rng)
+    np.testing.assert_array_equal(np.asarray(auto["q"]),
+                                  np.asarray(ref["q"]))
+    np.testing.assert_array_equal(np.asarray(auto["s"]),
+                                  np.asarray(ref["s"]))
+    forced = requantize(qt, upd, rng, fused=True)  # interpret kernel
+    np.testing.assert_array_equal(np.asarray(forced["q"]),
+                                  np.asarray(ref["q"]))
+    assert resolve_requant_mode("auto") is None
+    assert resolve_requant_mode("fused") is True
+    assert resolve_requant_mode("reference") is False
+    with pytest.raises(ValueError):
+        resolve_requant_mode("bogus")
+
+
+def test_requant_pallas_config_gate():
+    from code2vec_tpu.config import Config
+
+    cfg = Config(REQUANT_PALLAS="bogus")
+    cfg.train_data_path = "x"
+    with pytest.raises(ValueError):
+        cfg.verify()
+
+
+def test_fused_untouched_rows_stable():
+    """Same property as test_quant.test_requantize_untouched_rows_stable,
+    through the kernel: zero-update rows round-trip their scale to 1
+    ulp, so q is stable up to the ~1e-5-probability dither tail."""
+    r = np.random.default_rng(2)
+    t = jnp.asarray(r.normal(size=(64, 8)) * 0.5, jnp.float32)
+    qt = quantize_table(t)
+    upd = np.zeros((64, 8), np.float32)
+    upd[3] = 0.01  # one touched row
+    out = requantize_fused(qt, jnp.asarray(upd), jax.random.PRNGKey(0),
+                           block_rows=32)
+    dq, dq_new = np.asarray(qt["q"]), np.asarray(out["q"])
+    untouched = [i for i in range(64) if i != 3]
+    assert (dq_new[untouched] != dq[untouched]).sum() <= 1
+    assert (np.abs(dq_new[untouched].astype(int)
+                   - dq[untouched].astype(int)) <= 1).all()
+    row_f = np.asarray(dequantize_table(out))[3]
+    target = np.asarray(dequantize_table(qt))[3] + upd[3]
+    assert np.abs(row_f - target).max() <= np.asarray(out["s"])[3, 0]
+
+
+def test_fused_stochastic_rounding_unbiased():
+    """A 0.3-quantum update must survive in expectation through the
+    kernel's dither (deterministic rounding would drop it entirely)."""
+    r = np.random.default_rng(3)
+    t = jnp.asarray(np.abs(r.normal(size=(1, 512))) * 0.1 + 0.01,
+                    jnp.float32)
+    qt = quantize_table(t)
+    base = np.asarray(dequantize_table(qt)).mean()
+    upd = jnp.full((1, 512), float(np.asarray(qt["s"])[0, 0]) * 0.3,
+                   jnp.float32)
+    deltas = [np.asarray(dequantize_table(requantize_fused(
+        qt, upd, jax.random.PRNGKey(100 + k), block_rows=32))).mean()
+        - base for k in range(8)]
+    mean_delta = float(np.mean(deltas))
+    expect = float(np.asarray(upd).mean())
+    assert 0.5 * expect < mean_delta < 1.5 * expect, (mean_delta, expect)
+
+
+def test_requant_traffic_bytes():
+    qt, upd = _case(32, 8, upd_dtype=jnp.bfloat16)
+    # q r+w (1 B) + s r+w (4 B) + update read (2 B)
+    assert requant_traffic_bytes(qt, upd) == \
+        32 * 8 * 1 * 2 + 32 * 4 * 2 + 32 * 8 * 2
+
+
+def test_quantized_train_step_learns_through_fused_path():
+    """int8 tiny-model train smoke THROUGH the kernel: the same loss
+    trajectory contract as test_quant's reference-path version, with
+    requant_fused=True (interpret mode on this CPU platform)."""
+    params = init_params(jax.random.PRNGKey(3), DIMS)
+    opt = make_optimizer(0.05)
+    opt_state = opt.init(opt_param_view(params))
+    step = make_train_step(DIMS, opt, use_sampled_softmax=False,
+                           requant_fused=True)
+    r = np.random.default_rng(7)
+    batch = (jnp.asarray(r.integers(0, 24, 16), jnp.int32),
+             jnp.asarray(r.integers(0, 64, (16, 6)), jnp.int32),
+             jnp.asarray(r.integers(0, 32, (16, 6)), jnp.int32),
+             jnp.asarray(r.integers(0, 64, (16, 6)), jnp.int32),
+             jnp.ones((16, 6), jnp.float32),
+             jnp.ones((16,), jnp.float32))
+    losses = []
+    rng = jax.random.PRNGKey(4)
+    for _ in range(40):
+        rng, k = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state, batch, k)
+        losses.append(float(loss))
+    assert is_quantized(params["token_emb"])  # structure preserved
+    assert params["token_emb"]["q"].dtype == jnp.int8
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

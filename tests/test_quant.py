@@ -222,6 +222,34 @@ def test_int8_model_trains_and_roundtrips(tmp_path):
     assert loaded.topk_acc == pytest.approx(after.topk_acc)
 
 
+def test_int8_mesh_guard_covers_manifest_load(tmp_path):
+    """The multi-axis-mesh backstop must fire AFTER the checkpoint
+    manifest has set the tables dtype (ADVICE r5 finding 1): a
+    programmatic Config that LOADS an int8 checkpoint (so its own
+    TABLES_DTYPE default says bfloat16) onto a model-sharded mesh must
+    be rejected, not silently row-shard the {q, s} subtrees."""
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from tests.helpers import build_tiny_dataset
+    from tests.test_model import tiny_config
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    dataset = build_tiny_dataset(str(data_dir), n_train=64, n_val=16,
+                                 n_test=16, max_contexts=16)
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = tiny_config(dataset, TABLES_DTYPE="int8")
+    cfg.verify()
+    Code2VecModel(cfg).save(ckpt_dir)
+
+    cfg2 = tiny_config(dataset, MESH_MODEL_AXIS=2)
+    cfg2.load_path = ckpt_dir
+    # deliberately NO verify(): verify() could not catch this anyway
+    # (cfg2's TABLES_DTYPE still reads bfloat16 — only the manifest
+    # knows the checkpoint is int8)
+    with pytest.raises(ValueError, match="data-parallel meshes"):
+        Code2VecModel(cfg2)
+
+
 def test_int8_config_gates():
     """verify() rejects the combinations the int8 path does not cover."""
     from code2vec_tpu.config import Config
