@@ -41,7 +41,9 @@ in round 1 (700K) survives only as the real-world lower bound.
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
 
 import numpy as np
@@ -292,7 +294,16 @@ def _measure_encoder(encoder_type: str, tables_dtype: str = "bfloat16",
     return pc_per_sec, dt * 1e3, hbm_bytes / dt / 1e9
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    # argv=None (programmatic / test callers) means "no flags", NOT
+    # sys.argv — the CLI entry below passes sys.argv[1:] explicitly.
+    ap = argparse.ArgumentParser(description="one-chip java-large "
+                                             "throughput benchmark")
+    ap.add_argument("--telemetry_dir", default=None,
+                    help="also emit the measurements as telemetry "
+                         "events (code2vec_tpu/obs): BENCH rounds and "
+                         "train runs share one JSONL format")
+    args = ap.parse_args(argv if argv is not None else [])
     ceiling = _measure_hbm_ceiling()
     value, ms, hbm_gbps = _measure_encoder("bag")
     floor = _measure_fwd_bwd_floor()
@@ -300,7 +311,7 @@ def main() -> None:
     rq_ms, rq_bytes, rq_fused = _measure_requant_phase()
     rq_gbps = rq_bytes / (rq_ms / 1e3) / 1e9
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
-    print(json.dumps({
+    result = {
         "metric": "path-contexts/sec/chip",
         "value": round(value, 1),
         "unit": "path-contexts/sec/chip (java-large, sampled softmax, "
@@ -348,8 +359,17 @@ def main() -> None:
         "transformer_hbm_gbps": round(xf_hbm, 1),
         "transformer_vs_baseline": round(
             xf_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
-    }))
+    }
+    if args.telemetry_dir:
+        from code2vec_tpu.obs import Telemetry
+        tele = Telemetry.create(args.telemetry_dir, component="bench")
+        tele.event("bench", **result)
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                tele.gauge(f"bench/{k}", v, emit=False)
+        tele.close()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv[1:])

@@ -197,6 +197,17 @@ class Config:
     # as tf.summary scalars (host-side; TF is imported only when set).
     TENSORBOARD_DIR: Optional[str] = None
 
+    # ---- unified run telemetry (code2vec_tpu/obs/): --telemetry_dir
+    # <dir> opens a per-run JSONL event log + manifest and turns on
+    # per-step step_ms / infeed_wait_ms / loss records, device-memory
+    # gauges, and serving latency histograms. Unset (default): the
+    # per-step path is a single boolean check, nothing is allocated or
+    # written. NOTE: per-step records are device-sync-aware — enabling
+    # telemetry serializes step dispatch against the loss transfer
+    # (accurate attribution in exchange for pipelining; --profile stays
+    # the non-intrusive tool).
+    TELEMETRY_DIR: Optional[str] = None
+
     # ---- adversarial attacks (the noamyft fork delta, SURVEY.md §0
     # item 2; attacks/): --attack {targeted,untargeted} runs the
     # gradient-guided rename attack on --attack_input's source and
@@ -379,6 +390,13 @@ class Config:
                        default=None,
                        help="write loss/throughput/eval scalars as "
                             "TensorBoard summaries to this directory")
+        p.add_argument("--telemetry_dir", dest="telemetry_dir",
+                       default=None,
+                       help="unified run telemetry: per-run manifest + "
+                            "JSONL event log (per-step step_ms / "
+                            "infeed_wait_ms / loss, device-memory "
+                            "gauges, serving latency); summarize with "
+                            "tools/telemetry_report.py")
         p.add_argument("--attack", dest="attack", default=None,
                        choices=["targeted", "untargeted"],
                        help="gradient-guided variable-rename attack on "
@@ -500,6 +518,8 @@ class Config:
             cfg.PROFILE_STEPS = ns.profile_steps
         if ns.tensorboard_dir is not None:
             cfg.TENSORBOARD_DIR = ns.tensorboard_dir
+        if ns.telemetry_dir is not None:
+            cfg.TELEMETRY_DIR = ns.telemetry_dir
         if ns.attack is not None:
             cfg.ATTACK = ns.attack
         if ns.attack_target is not None:

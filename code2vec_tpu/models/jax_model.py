@@ -278,13 +278,25 @@ class Code2VecModel(Code2VecModelBase):
         from code2vec_tpu.training.scalars import ScalarWriter
         scalars = ScalarWriter(cfg.TENSORBOARD_DIR
                                if jax.process_index() == 0 else None)
+        # Unified run telemetry (code2vec_tpu/obs/): per-step
+        # step_ms/infeed_wait_ms/loss events + device-memory gauges when
+        # --telemetry_dir is set; the disabled path is one boolean check
+        # per step (recorder.enabled) and wrap() returns the infeed
+        # unchanged.
+        from code2vec_tpu.obs import Telemetry, TrainStepRecorder
+        telemetry = Telemetry.create(
+            cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
+            component="train", scalar_writer=scalars, log=self.log)
+        self.telemetry = telemetry
+        recorder = TrainStepRecorder(
+            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
         # host->device transfer of batch k+1 overlap step k on a daemon
         # thread; the loop below never blocks on the host between steps.
         infeed = self._train_infeed(reader)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for dev_batch, batch in infeed:
+            for dev_batch, batch in recorder.wrap(infeed):
                 profiler.tick(steps_into_training, self.params)
                 self.rng, step_rng = jax.random.split(self.rng)
                 self.params, self.opt_state, loss = self._train_step(
@@ -292,8 +304,13 @@ class Code2VecModel(Code2VecModelBase):
                 self.step_num += 1
                 steps_into_training += 1
                 window_examples += batch.num_valid_examples
+                loss_f = (recorder.end_step(self.step_num, loss,
+                                            batch.num_valid_examples)
+                          if recorder.enabled else None)
                 if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
-                    loss_f = float(loss)  # device sync only on log steps
+                    if loss_f is None:
+                        # device sync only on log steps
+                        loss_f = float(loss)
                     dt = time.time() - window_start
                     ex_s = window_examples / max(dt, 1e-9)
                     # path-contexts/sec = examples/sec * MAX_CONTEXTS —
@@ -308,10 +325,14 @@ class Code2VecModel(Code2VecModelBase):
                         "train/path_contexts_per_sec":
                             ex_s * cfg.MAX_CONTEXTS})
                     window_examples, window_start = 0, time.time()
+            epoch_end_work = False
             if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                self.save(cfg.save_path)
+                with telemetry.timed("train/save_ms"):
+                    self.save(cfg.save_path)
+                epoch_end_work = True
             if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                results = self.evaluate()
+                with telemetry.timed("train/eval_ms"):
+                    results = self.evaluate()
                 self.log(f"epoch {epoch} evaluation: {results}")
                 scalars.write(self.step_num, {
                     "eval/loss": results.loss,
@@ -319,7 +340,17 @@ class Code2VecModel(Code2VecModelBase):
                     "eval/subtoken_f1": results.subtoken_f1,
                     "eval/subtoken_precision": results.subtoken_precision,
                     "eval/subtoken_recall": results.subtoken_recall})
+                telemetry.event("eval", epoch=epoch, step=self.step_num,
+                                loss=results.loss,
+                                subtoken_f1=results.subtoken_f1)
+                epoch_end_work = True
+            if epoch_end_work:
+                # reset the throughput window: checkpoint + eval wall
+                # time must not be silently absorbed into the next
+                # epoch's first ex/s figure
+                window_examples, window_start = 0, time.time()
         profiler.finish(self.params)
+        telemetry.close()
         scalars.close()
         self.log("training done")
 
@@ -389,6 +420,8 @@ class Code2VecModel(Code2VecModelBase):
         lines = [ln for ln in predict_data_lines if ln.strip()]
         if not lines:
             return []
+        # host phase: raw lines -> padded tensors (serve/encode_ms)
+        encode_span = self.telemetry.span("serve/encode_ms")
         labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
             lines, self.vocabs, cfg.MAX_CONTEXTS, keep_strings=True)
         n = len(lines)
@@ -407,12 +440,17 @@ class Code2VecModel(Code2VecModelBase):
         batch = (labels, src, pth, dst, mask, weights)
         if self.mesh is not None:
             batch = shard_batch(self.mesh, batch, process_local=False)
+        encode_span.stop()
+        # device phase: jitted step + host fetch (serve/predict_ms; the
+        # fetch_global transfers are the device sync)
+        predict_span = self.telemetry.span("serve/predict_ms")
         topk_ids, topk_probs, attn, code = self._predict_step(
             self.params, batch)
         topk_ids = fetch_global(topk_ids)
         topk_probs = fetch_global(topk_probs)
         attn = fetch_global(attn)
         code = fetch_global(code)
+        predict_span.stop()
         results = []
         for i, original in enumerate(tstr):
             res = MethodPredictionResults(original_name=original)

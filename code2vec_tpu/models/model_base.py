@@ -83,6 +83,12 @@ class MetricAccumulator:
 class Code2VecModelBase(abc.ABC):
     def __init__(self, config: Config):
         self.config = config
+        # run telemetry (code2vec_tpu/obs/): train() replaces this with
+        # a file-backed run when --telemetry_dir is set, and the serving
+        # REPL injects its always-on latency registry; the disabled
+        # singleton keeps predict()'s span calls branch-free.
+        from code2vec_tpu.obs import Telemetry
+        self.telemetry = Telemetry.disabled()
         self.vocabs: Code2VecVocabs = self._load_or_create_vocabs()
 
     # ---- lifecycle ----

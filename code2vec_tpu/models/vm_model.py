@@ -42,6 +42,8 @@ class VarMisuseModel:
     def __init__(self, config: Config):
         cfg = self.config = config
         self.log = cfg.log
+        from code2vec_tpu.obs import Telemetry
+        self.telemetry = Telemetry.disabled()  # train() swaps it in
         self.compute_dtype = jnp.bfloat16 if cfg.USE_BF16 else jnp.float32
         # Pallas kernels are TPU-only; fall back to the XLA pool
         # elsewhere (tests run on the virtual CPU mesh).
@@ -152,6 +154,16 @@ class VarMisuseModel:
         window, t0 = 0, time.time()
         profiler = StepProfiler(cfg.PROFILE_DIR, cfg.PROFILE_START_STEP,
                                 cfg.PROFILE_STEPS, self.log)
+        # Unified run telemetry (code2vec_tpu/obs/) — same per-step
+        # step_ms/infeed_wait_ms/loss records as the code2vec head; the
+        # shared recorder keeps the two loops' metrics comparable.
+        from code2vec_tpu.obs import Telemetry, TrainStepRecorder
+        telemetry = Telemetry.create(
+            cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
+            component="train", log=self.log)
+        self.telemetry = telemetry
+        recorder = TrainStepRecorder(
+            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
         steps_into_training = 0
         from code2vec_tpu.data.prefetch import build_train_infeed
         infeed = build_train_infeed(
@@ -159,7 +171,7 @@ class VarMisuseModel:
             mesh=self.mesh, host_arrays_fn=self._host_batch_arrays,
             device_batch_fn=self._device_batch, log=self.log)
         for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for dev_batch, batch in infeed:
+            for dev_batch, batch in recorder.wrap(infeed):
                 profiler.tick(steps_into_training, self.params)
                 steps_into_training += 1
                 self.rng, k = jax.random.split(self.rng)
@@ -167,17 +179,36 @@ class VarMisuseModel:
                     self.params, self.opt_state, dev_batch, k)
                 self.step_num += 1
                 window += batch.num_valid_examples
+                loss_f = (recorder.end_step(self.step_num, loss,
+                                            batch.num_valid_examples)
+                          if recorder.enabled else None)
                 if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
+                    if loss_f is None:
+                        loss_f = float(loss)
                     dt = time.time() - t0
                     self.log(f"vm epoch {epoch} step {self.step_num}: "
-                             f"loss {float(loss):.4f}, "
+                             f"loss {loss_f:.4f}, "
                              f"{window / max(dt, 1e-9):.1f} ex/s")
                     window, t0 = 0, time.time()
+            epoch_end_work = False
             if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                self.save()
+                with telemetry.timed("train/save_ms"):
+                    self.save()
+                epoch_end_work = True
             if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                self.log(f"vm epoch {epoch}: {self.evaluate()}")
+                with telemetry.timed("train/eval_ms"):
+                    results = self.evaluate()
+                self.log(f"vm epoch {epoch}: {results}")
+                telemetry.event("eval", epoch=epoch, step=self.step_num,
+                                loss=results.loss,
+                                accuracy=results.accuracy)
+                epoch_end_work = True
+            if epoch_end_work:
+                # checkpoint/eval wall time must not leak into the next
+                # window's first ex/s figure (same fix as jax_model)
+                window, t0 = 0, time.time()
         profiler.finish(self.params)
+        telemetry.close()
         self.log("varmisuse training done")
 
     def evaluate(self, split_path: Optional[str] = None) -> VMEvalResults:

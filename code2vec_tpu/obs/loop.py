@@ -1,0 +1,102 @@
+"""Train-loop instrumentation shared by both model heads.
+
+`TrainStepRecorder` answers the question the throughput log line can't:
+is the step device-bound or infeed-bound? Per step it records
+
+  - `infeed_wait_ms` — host time blocked on the double-buffered infeed
+    (data/prefetch.py). Near zero while the producer thread keeps up;
+    grows exactly when the input pipeline, not the chip, is the
+    bottleneck.
+  - `step_ms` — wall time from infeed yield to step completion,
+    device-sync-aware: the recorder syncs via the loss scalar's host
+    transfer, so the figure bounds the dispatched device work (and the
+    loss ride-along means per-step loss costs no extra transfer).
+  - periodic device-memory gauges (`bytes_in_use`,
+    `peak_bytes_in_use`) where the backend exposes them.
+
+Cost model: telemetry is opt-in (`--telemetry_dir`), and enabling it
+trades step pipelining for attribution — the per-step device sync
+serializes the loop (steps no longer overlap the next host dispatch).
+That is the documented price of in-band per-step numbers; the
+jax.profiler trace window (`--profile`) remains the non-intrusive tool.
+Disabled, the recorder costs ONE boolean check per step and `wrap()`
+returns the infeed unchanged — zero per-step allocation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable
+
+from code2vec_tpu.obs.telemetry import Telemetry
+
+
+class TrainStepRecorder:
+    """Per-step telemetry for a `for dev_batch, batch in infeed:` loop.
+
+    Usage (both heads):
+        rec = TrainStepRecorder(telemetry, gauge_every=N)
+        for epoch ...:
+            for dev_batch, batch in rec.wrap(infeed):
+                ... dispatch step ...
+                loss_f = rec.end_step(step_num, loss, n) \
+                    if rec.enabled else None
+    """
+
+    def __init__(self, telemetry: Telemetry, gauge_every: int = 100):
+        self.enabled = telemetry.enabled
+        self._tele = telemetry
+        self._gauge_every = max(1, gauge_every)
+        self._steps = 0
+        self._infeed_wait_ms = 0.0
+        self._t_yield = 0.0
+
+    def wrap(self, infeed: Iterable) -> Iterable:
+        """Time the infeed pops. Disabled: returns `infeed` itself, so
+        the loop iterates exactly what it iterated before."""
+        if not self.enabled:
+            return infeed
+        return self._timed_iter(infeed)
+
+    def _timed_iter(self, infeed: Iterable):
+        it = iter(infeed)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            now = time.perf_counter()
+            self._infeed_wait_ms = (now - t0) * 1e3
+            self._t_yield = now
+            yield item
+
+    def end_step(self, step: int, loss, n_examples: int) -> float:
+        """Close the current step: sync on the loss transfer, record the
+        step/infeed timers, write the per-step event. Returns the loss
+        as a float so the loop's log line reuses the one transfer."""
+        loss_f = float(loss)  # device sync: bounds the dispatched step
+        now = time.perf_counter()
+        step_ms = (now - self._t_yield) * 1e3
+        tele = self._tele
+        tele.record_ms("train/step_ms", step_ms)
+        tele.record_ms("train/infeed_wait_ms", self._infeed_wait_ms)
+        tele.count("train/steps")
+        tele.count("train/examples", int(n_examples))
+        tele.event("step", step=int(step), step_ms=round(step_ms, 3),
+                   infeed_wait_ms=round(self._infeed_wait_ms, 3),
+                   loss=round(loss_f, 6), examples=int(n_examples))
+        self._steps += 1
+        if self._steps % self._gauge_every == 0:
+            self._device_memory_gauges()
+        return loss_f
+
+    def _device_memory_gauges(self) -> None:
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:  # backend without memory_stats (CPU)
+            return
+        for key in ("bytes_in_use", "peak_bytes_in_use"):
+            if key in stats:
+                self._tele.gauge(f"device/{key}", int(stats[key]))

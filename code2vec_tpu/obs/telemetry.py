@@ -1,0 +1,357 @@
+"""Unified run telemetry: one registry, pluggable sinks.
+
+ISSUE 2 (observability): the point tools that accreted around the train
+loop — `training/profiler.py` trace windows, `training/scalars.py`
+TensorBoard scalars, bench.py's hand-rolled slope timing — don't
+compose, and none of them can answer the production questions in-band:
+is the step device-bound or infeed-bound, what does a serving request
+cost at p99, what did THIS run record. `Telemetry` is the one layer
+they all feed:
+
+  - counters / gauges / timer histograms (p50/p95/p99 + max) held
+    in-process, cheap enough for per-step recording;
+  - pluggable sinks (obs/sinks.py): a per-run JSONL event log under
+    `--telemetry_dir` opened with a run manifest (run_id, config
+    snapshot, device/mesh topology, process index), a TensorBoard
+    adapter reusing `ScalarWriter`, and stdout;
+  - span helpers explicit about host-vs-device time: `span()` is a
+    plain monotonic host timer; `span().stop(sync=tree)` blocks on a
+    device tree first (the same hard-sync trick `StepProfiler` uses),
+    so step latency measures the chip, not the dispatch.
+
+Dependency-light by contract: this module imports only the stdlib at
+import time; jax is imported lazily (manifest topology, device sync)
+and TensorFlow never (the TensorBoard sink reuses an externally-owned
+`ScalarWriter`, which itself degrades to a no-op without TF). The
+disabled path (`--telemetry_dir` unset) is a shared singleton whose
+`enabled` is False — hot loops guard on that ONE boolean and allocate
+nothing per step.
+
+Not thread-safe: record from the loop thread that owns the instance
+(the infeed producer thread never touches telemetry).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+__all__ = ["Telemetry", "TimerStat", "device_sync"]
+
+# percentiles every summary reports; the serving latency line and
+# tools/telemetry_report.py render exactly these
+SUMMARY_PERCENTILES = (50, 95, 99)
+
+
+def device_sync(tree) -> None:
+    """Block until `tree`'s device computation has completed.
+
+    Sync via a host transfer of a tiny on-device reduction:
+    block_until_ready can return early on the tunneled axon platform
+    (BASELINE.md timing methodology), which would time a step while its
+    work is still in flight. Shared with StepProfiler._stop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        float(jnp.sum(leaf[..., :1].astype(jnp.float32)))
+    except Exception:
+        jax.block_until_ready(tree)
+
+
+class TimerStat:
+    """Streaming timer histogram: exact count/total/max plus a bounded
+    sample ring for percentiles (the last `cap` samples — recent-window
+    percentiles, which is what a long run wants anyway: p99 of the
+    current regime, not of compile-step outliers hours ago)."""
+
+    __slots__ = ("count", "total_ms", "max_ms", "_ring", "_cap")
+
+    def __init__(self, cap: int = 2048):
+        assert cap >= 1
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self._cap = cap
+        self._ring: list = []
+
+    def record(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        if len(self._ring) < self._cap:
+            self._ring.append(ms)
+        else:
+            self._ring[self.count % self._cap] = ms
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else float("nan")
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the sample window."""
+        if not self._ring:
+            return float("nan")
+        s = sorted(self._ring)
+        k = int(round(p / 100.0 * (len(s) - 1)))
+        return s[max(0, min(len(s) - 1, k))]
+
+    def summary(self) -> Dict[str, float]:
+        out: Dict[str, float] = {"count": self.count,
+                                 "mean_ms": round(self.mean_ms, 4),
+                                 "max_ms": round(self.max_ms, 4)}
+        for p in SUMMARY_PERCENTILES:
+            out[f"p{p}_ms"] = round(self.percentile(p), 4)
+        return out
+
+
+class _Span:
+    """One in-flight timing: host-monotonic start at construction,
+    `stop()` records into the owning timer. `stop(sync=tree)` makes it
+    device-sync-aware: the span ends only when the device work behind
+    `tree` has completed, so it measures chip time, not dispatch time.
+    """
+
+    __slots__ = ("_tele", "_name", "_t0")
+
+    def __init__(self, tele: "Telemetry", name: str):
+        self._tele = tele
+        self._name = name
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync=None) -> float:
+        if sync is not None:
+            device_sync(sync)
+        ms = (time.perf_counter() - self._t0) * 1e3
+        self._tele.record_ms(self._name, ms)
+        return ms
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def stop(self, sync=None) -> float:
+        return 0.0
+
+
+_NULL_SPAN = _NullSpan()
+
+# run ids within one process get a monotonic suffix so two runs created
+# in the same second (tests, back-to-back tools) never collide
+_RUN_SEQ = [0]
+
+
+def _build_run_manifest(config, mesh, component: str) -> Dict[str, Any]:
+    process_index, process_count = 0, 1
+    devices: Dict[str, Any] = {}
+    try:  # lazy: keep obs importable (and fast) without a backend
+        import jax
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+        devs = jax.devices()
+        devices = {"platform": devs[0].platform, "count": len(devs),
+                   "local_count": len(jax.local_devices())}
+    except Exception:
+        pass
+    _RUN_SEQ[0] += 1
+    run_id = (f"run-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+              f"-p{process_index}-{_RUN_SEQ[0]}")
+    manifest: Dict[str, Any] = {
+        "run_id": run_id,
+        "component": component,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "created_unix": time.time(),
+        "process_index": process_index,
+        "process_count": process_count,
+        "devices": devices,
+    }
+    if mesh is not None:
+        try:
+            manifest["mesh"] = dict(zip(mesh.axis_names,
+                                        mesh.devices.shape))
+        except Exception:
+            manifest["mesh"] = str(mesh)
+    if config is not None:
+        try:
+            manifest["config"] = dataclasses.asdict(config)
+        except TypeError:
+            manifest["config"] = {
+                k: v for k, v in vars(config).items()
+                if isinstance(v, (int, float, str, bool, type(None)))}
+    return manifest
+
+
+class Telemetry:
+    """Registry of counters, gauges and timer histograms feeding a list
+    of sinks. Construct via `create()` (file-backed run, or the shared
+    disabled singleton when no directory is given) or `memory()` (live
+    histograms, no persistence — the serving REPL's always-on mode)."""
+
+    def __init__(self, sinks: Sequence = (), run_id: str = "",
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.run_id = run_id
+        self.run_dir: Optional[str] = None
+        self.sinks = list(sinks)
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, TimerStat] = {}
+
+    # ---- construction ----
+    @classmethod
+    def create(cls, telemetry_dir: Optional[str], *, config=None,
+               mesh=None, component: str = "run", scalar_writer=None,
+               log: Optional[Callable[[str], None]] = None) -> "Telemetry":
+        """File-backed run telemetry under `telemetry_dir/<run_id>/`:
+        `manifest.json` plus an `events.jsonl` sink (and optionally the
+        TensorBoard adapter over an existing ScalarWriter and a stdout
+        sink over `log`). Returns the disabled singleton when
+        `telemetry_dir` is falsy — the call site needs no branching."""
+        if not telemetry_dir:
+            return _NULL
+        from code2vec_tpu.obs.sinks import (JsonlSink, ScalarSink,
+                                            StdoutSink)
+        manifest = _build_run_manifest(config, mesh, component)
+        run_dir = os.path.join(telemetry_dir, manifest["run_id"])
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2, default=str)
+        sinks: list = [JsonlSink(os.path.join(run_dir, "events.jsonl"))]
+        if scalar_writer is not None:
+            sinks.append(ScalarSink(scalar_writer))
+        if log is not None:
+            sinks.append(StdoutSink(log))
+        tele = cls(sinks, run_id=manifest["run_id"])
+        tele.run_dir = run_dir
+        if log is not None:
+            log(f"telemetry: run {manifest['run_id']} -> {run_dir}")
+        return tele
+
+    @classmethod
+    def memory(cls, component: str = "run") -> "Telemetry":
+        """Enabled registry with no sinks: histograms live in-process
+        only. Serving uses this when --telemetry_dir is unset so the
+        p50/p95/p99 request line still works without persistence."""
+        return cls((), run_id=f"mem-{component}")
+
+    @classmethod
+    def disabled(cls) -> "Telemetry":
+        return _NULL
+
+    # ---- recording ----
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float, emit: bool = True) -> None:
+        self.gauges[name] = value
+        if emit:
+            self.event("gauge", name=name, value=value)
+
+    def timer(self, name: str) -> TimerStat:
+        t = self.timers.get(name)
+        if t is None:
+            t = self.timers[name] = TimerStat()
+        return t
+
+    def record_ms(self, name: str, ms: float) -> None:
+        self.timer(name).record(ms)
+
+    def span(self, name: str) -> _Span:
+        """Start a host-monotonic span; `stop()` records it, and
+        `stop(sync=tree)` waits for device work first (host-vs-device
+        explicitness lives in the call, not the name)."""
+        return _Span(self, name)
+
+    def timed(self, name: str):
+        """Context-manager form of `span` for plain host phases."""
+        return self._timed(name)
+
+    @contextlib.contextmanager
+    def _timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_ms(name, (time.perf_counter() - t0) * 1e3)
+
+    def event(self, kind: str, **fields) -> None:
+        """One structured record to every sink. Sinks see a flat dict
+        with `kind` and a wall-clock `ts`."""
+        if not self.sinks:
+            return
+        ev: Dict[str, Any] = {"kind": kind, "ts": round(time.time(), 6)}
+        ev.update(fields)
+        for s in self.sinks:
+            s.write(ev)
+
+    # ---- lifecycle ----
+    def summary(self) -> Dict[str, Any]:
+        return {"counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {k: t.summary()
+                           for k, t in sorted(self.timers.items())}}
+
+    def close(self) -> None:
+        if not self.enabled:
+            return
+        if self.sinks:
+            self.event("summary", **self.summary())
+        for s in self.sinks:
+            s.close()
+        self.sinks = []
+
+
+class _NullTelemetry(Telemetry):
+    """The `--telemetry_dir`-unset path: every method a no-op, shared
+    singleton, `enabled=False` so hot loops skip with one check."""
+
+    _NULL_TIMER = TimerStat(cap=1)
+
+    def __init__(self):
+        super().__init__((), run_id="disabled", enabled=False)
+
+    def count(self, name, n=1):
+        pass
+
+    def gauge(self, name, value, emit=True):
+        pass
+
+    def timer(self, name):
+        return self._NULL_TIMER
+
+    def record_ms(self, name, ms):
+        pass
+
+    def span(self, name):
+        return _NULL_SPAN
+
+    def timed(self, name):
+        return contextlib.nullcontext()
+
+    def event(self, kind, **fields):
+        pass
+
+    def close(self):
+        pass
+
+
+_NULL = _NullTelemetry()
+
+
+def format_latency_line(stat: TimerStat, last_ms: Optional[float] = None,
+                        what: str = "request") -> str:
+    """The serving REPL's one-line latency report."""
+    s = stat.summary()
+    head = (f"latency: {what} {last_ms:.1f} ms | "
+            if last_ms is not None else "latency: ")
+    return (head + f"p50 {s['p50_ms']:.1f} / p95 {s['p95_ms']:.1f} / "
+            f"p99 {s['p99_ms']:.1f} / max {s['max_ms']:.1f} ms "
+            f"over {s['count']} {what}s")

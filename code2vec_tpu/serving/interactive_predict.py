@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 
 from code2vec_tpu.config import Config
+from code2vec_tpu.obs import Telemetry, format_latency_line
 from code2vec_tpu.serving.extractor import Extractor, ExtractorError
 
 SHOW_TOP_CONTEXTS = 10
@@ -23,6 +24,22 @@ class InteractivePredictor:
         self.config = config
         self.model = model
         self.extractor = Extractor(config)
+        # Serving latency histograms (code2vec_tpu/obs/): per-request
+        # extract/encode/predict timers are ALWAYS live (per-request
+        # cost is trivial; the p50/p95/p99 line is the product surface),
+        # persisted as JSONL events only when --telemetry_dir is set.
+        # Serving opens its OWN run: a train run in the same process
+        # (code2vec.py --data ... --predict) closed its event log when
+        # train() returned, so the serve phase gets a fresh run dir.
+        tele = Telemetry.create(config.TELEMETRY_DIR, config=config,
+                                mesh=getattr(model, "mesh", None),
+                                component="serve")
+        if not tele.enabled:
+            tele = Telemetry.memory("serve")
+        self.telemetry = tele
+        # model.predict() records its serve/encode_ms and
+        # serve/predict_ms spans into the same registry
+        model.telemetry = tele
 
     def predict(self, input_file: str = DEFAULT_INPUT_FILE) -> None:
         print(f"Serving. Modify the file: \"{input_file}\", then press any "
@@ -33,6 +50,7 @@ class InteractivePredictor:
             user_input = input()
             if user_input.strip().lower() in EXIT_KEYWORDS:
                 print("Exiting...")
+                self.telemetry.close()  # flush the serve run's summary
                 return
             if not os.path.exists(input_file):
                 print(f"File not found: {input_file}")
@@ -42,12 +60,21 @@ class InteractivePredictor:
                 self._attack(input_file,
                              words[1] if len(words) > 1 else None)
                 continue
+            request_span = self.telemetry.span("serve/request_ms")
+            extract_span = self.telemetry.span("serve/extract_ms")
             try:
                 _, lines = self.extractor.extract_paths(input_file)
             except ExtractorError as e:
                 print(f"Extraction error: {e}")
                 continue
+            extract_ms = extract_span.stop()
             results = self.model.predict(lines)
+            request_ms = request_span.stop()
+            self.telemetry.count("serve/requests")
+            self.telemetry.event(
+                "request", request_ms=round(request_ms, 3),
+                extract_ms=round(extract_ms, 3),
+                n_methods=len(results))
             for res in results:
                 print(f"Original name:\t{res.original_name}")
                 for pred in res.predictions:
@@ -60,6 +87,8 @@ class InteractivePredictor:
                 if res.code_vector is not None:
                     print("Code vector:")
                     print(" ".join(f"{x:.5f}" for x in res.code_vector))
+            print(format_latency_line(
+                self.telemetry.timer("serve/request_ms"), request_ms))
 
     def _attack(self, input_file: str, target: str) -> None:
         """REPL `attack [targetName]` command: run the gradient rename

@@ -61,17 +61,14 @@ class StepProfiler:
 
     def _stop(self, sync_leaf) -> None:
         import jax
-        import jax.numpy as jnp
 
-        # Sync via a host transfer of a tiny on-device reduction:
-        # block_until_ready can return early on the tunneled axon
-        # platform (BASELINE.md timing methodology), which would stop
-        # the trace while traced steps are still in flight.
-        try:
-            leaf = jax.tree_util.tree_leaves(sync_leaf)[0]
-            float(jnp.sum(leaf[..., :1].astype(jnp.float32)))
-        except Exception:
-            jax.block_until_ready(sync_leaf)
+        # Hard sync (host transfer of a tiny reduction, shared with the
+        # telemetry spans — obs.device_sync): block_until_ready can
+        # return early on the tunneled axon platform (BASELINE.md timing
+        # methodology), which would stop the trace while traced steps
+        # are still in flight.
+        from code2vec_tpu.obs import device_sync
+        device_sync(sync_leaf)
         jax.profiler.stop_trace()
         self._active = False
         self._done = True

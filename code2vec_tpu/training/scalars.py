@@ -2,14 +2,21 @@
 
 SURVEY.md §6 (metrics row): the reference logs loss/throughput lines to
 Python logging only; TensorBoard scalars are the optional TPU-build
-addition. Host-side and dependency-light: TensorFlow (installed for the
-baseline tooling) is imported lazily, only when a directory is given —
-the training path never touches TF otherwise.
+addition. Host-side and dependency-light: TensorFlow is imported
+lazily, only when a directory is given — and when it is missing
+entirely the writer degrades to a warn-once no-op instead of raising,
+so a TF-free training image keeps the same command line (the JSONL
+telemetry under --telemetry_dir stays the durable record).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Mapping, Optional
+
+# warn-once latch for the missing-TF fallback (module-level: one
+# warning per process, not one per writer)
+_WARNED_MISSING_TF = False
 
 
 class ScalarWriter:
@@ -19,7 +26,18 @@ class ScalarWriter:
     def __init__(self, log_dir: Optional[str]):
         self._writer = None
         if log_dir:
-            import tensorflow as tf  # lazy: only with --tensorboard
+            try:
+                import tensorflow as tf  # lazy: only with --tensorboard
+            except Exception:  # ImportError, or a broken TF install
+                global _WARNED_MISSING_TF
+                if not _WARNED_MISSING_TF:
+                    _WARNED_MISSING_TF = True
+                    logging.getLogger("code2vec-tpu").warning(
+                        "--tensorboard %s requested but TensorFlow is "
+                        "not importable; scalar streaming disabled "
+                        "(install tensorflow, or use --telemetry_dir "
+                        "for the TF-free JSONL record)", log_dir)
+                return
             self._writer = tf.summary.create_file_writer(log_dir)
             self._tf = tf
 

@@ -1,0 +1,85 @@
+"""TF-free guard (ISSUE 2 satellite): `code2vec_tpu.obs` must import —
+and the disabled + file-backed telemetry paths must run — on an image
+with no TensorFlow at all, and tier-1 test COLLECTION must never pull
+TensorFlow in (TF is a tooling dependency, not a training one).
+
+Both tests run subprocesses with a blocker module shadowing
+`tensorflow` on PYTHONPATH, so any import attempt anywhere in the
+chain fails loudly instead of silently using the locally-installed TF.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tf_blocked_env(tmp_path):
+    blocker = tmp_path / "tfblock"
+    blocker.mkdir()
+    (blocker / "tensorflow.py").write_text(
+        "raise ImportError('tensorflow blocked by test_obs_guard')\n")
+    env = dict(os.environ)
+    parts = [str(blocker), REPO]
+    if env.get("PYTHONPATH"):
+        parts.append(env["PYTHONPATH"])
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_obs_imports_and_runs_without_tensorflow(tmp_path):
+    code = textwrap.dedent("""
+        import json, os, sys, tempfile
+        import code2vec_tpu.obs as obs
+
+        # disabled path (the --telemetry_dir-unset production default)
+        t = obs.Telemetry.disabled()
+        assert not t.enabled
+        t.count("x"); t.record_ms("a", 1.0); t.event("k"); t.close()
+        rec = obs.TrainStepRecorder(t)
+        infeed = [1]
+        assert rec.wrap(infeed) is infeed
+
+        # memory + file-backed paths
+        m = obs.Telemetry.memory("guard")
+        m.record_ms("a", 1.0)
+        assert m.timer("a").count == 1
+        d = tempfile.mkdtemp()
+        run = obs.Telemetry.create(d, component="guard")
+        run.event("step", step=1, step_ms=1.0, infeed_wait_ms=0.0,
+                  loss=0.5)
+        run.close()
+        assert os.path.exists(os.path.join(run.run_dir,
+                                           "manifest.json"))
+
+        # the ScalarWriter fallback rides the same no-TF constraint
+        from code2vec_tpu.training.scalars import ScalarWriter
+        w = ScalarWriter(d)   # TF blocked -> warn-once no-op
+        assert w._writer is None
+        w.write(1, {"a": 1.0}); w.close()
+
+        assert "tensorflow" not in sys.modules
+        print("GUARD-OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code],
+                       env=_tf_blocked_env(tmp_path), cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GUARD-OK" in r.stdout
+
+
+def test_tier1_collection_is_tf_free(tmp_path):
+    """`pytest --collect-only` over the tier-1 selection with TF
+    blocked: any test module importing TensorFlow at module scope
+    fails collection here before it can fail tier-1 on a TF-free
+    image."""
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "--collect-only",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        env=_tf_blocked_env(tmp_path), cwd=REPO, capture_output=True,
+        text=True, timeout=540)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    assert "error" not in r.stdout.lower().splitlines()[-1]

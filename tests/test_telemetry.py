@@ -1,0 +1,327 @@
+"""Unified run telemetry (code2vec_tpu/obs, ISSUE 2): registry + sink
+contracts, the guaranteed-cheap disabled path, the CPU smoke train run
+writing manifest + per-step JSONL (step_ms / infeed_wait_ms / loss),
+tools/telemetry_report.py summarizing it into the BASELINE.md table
+shape, and the serving REPL's p50/p95/p99 request-latency line."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.obs import (Telemetry, TimerStat, TrainStepRecorder,
+                              format_latency_line)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_report_tool():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(REPO, "tools",
+                                         "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_events(run_dir):
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _one_run_dir(telemetry_dir):
+    runs = [d for d in os.listdir(telemetry_dir)
+            if os.path.exists(os.path.join(telemetry_dir, d,
+                                           "manifest.json"))]
+    assert len(runs) == 1, runs
+    return os.path.join(telemetry_dir, runs[0])
+
+
+# ---- registry ----
+
+def test_timer_stat_percentiles_and_summary():
+    t = TimerStat()
+    for v in range(1, 101):
+        t.record(float(v))
+    s = t.summary()
+    assert s["count"] == 100
+    assert s["max_ms"] == 100.0
+    assert abs(s["mean_ms"] - 50.5) < 1e-9
+    assert 49 <= s["p50_ms"] <= 51
+    assert 94 <= s["p95_ms"] <= 96
+    assert 98 <= s["p99_ms"] <= 100
+
+
+def test_timer_stat_ring_keeps_recent_window():
+    t = TimerStat(cap=8)
+    for v in (1000.0,) * 8 + (1.0,) * 64:  # old outliers age out
+        t.record(v)
+    assert t.percentile(99) == 1.0
+    assert t.max_ms == 1000.0  # exact max survives the ring
+    assert t.count == 72
+
+
+def test_disabled_is_shared_singleton_and_noop(tmp_path):
+    a = Telemetry.create(None)
+    assert a is Telemetry.disabled()
+    assert not a.enabled
+    a.count("c")
+    a.gauge("g", 1.0)
+    a.record_ms("t", 5.0)
+    a.event("step", step=1)
+    assert a.span("s").stop() == 0.0
+    with a.timed("x"):
+        pass
+    a.close()
+    assert a.counters == {} and a.timers == {}
+    # the recorder's disabled path: wrap() is identity, enabled is the
+    # single per-step check the loops guard on
+    rec = TrainStepRecorder(a)
+    infeed = [1, 2, 3]
+    assert rec.wrap(infeed) is infeed
+    assert rec.enabled is False
+
+
+def test_memory_mode_records_without_sinks():
+    tele = Telemetry.memory("serve")
+    assert tele.enabled and not tele.sinks
+    tele.record_ms("serve/request_ms", 7.0)
+    tele.event("request", request_ms=7.0)  # no sink: must not raise
+    assert tele.timer("serve/request_ms").count == 1
+    tele.close()
+
+
+def test_file_backed_run_manifest_and_events(tmp_path):
+    cfg = Config(MAX_CONTEXTS=16, TRAIN_BATCH_SIZE=8)
+    tele = Telemetry.create(str(tmp_path), config=cfg, component="unit")
+    assert tele.enabled
+    tele.event("step", step=1, step_ms=1.5, infeed_wait_ms=0.2,
+               loss=2.25, examples=8)
+    tele.record_ms("train/step_ms", 1.5)
+    tele.gauge("device/bytes_in_use", 4096)
+    tele.count("train/steps")
+    tele.close()
+    run_dir = tele.run_dir
+    with open(os.path.join(run_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["run_id"] == tele.run_id
+    assert manifest["component"] == "unit"
+    assert "process_index" in manifest and "devices" in manifest
+    assert manifest["config"]["MAX_CONTEXTS"] == 16
+    events = _read_events(run_dir)
+    kinds = [e["kind"] for e in events]
+    assert "step" in kinds and "gauge" in kinds
+    assert kinds[-1] == "summary"
+    summary = events[-1]
+    assert summary["timers"]["train/step_ms"]["count"] == 1
+    assert summary["counters"]["train/steps"] == 1
+    assert summary["gauges"]["device/bytes_in_use"] == 4096
+
+
+def test_two_runs_same_process_get_distinct_run_ids(tmp_path):
+    a = Telemetry.create(str(tmp_path), component="a")
+    b = Telemetry.create(str(tmp_path), component="b")
+    assert a.run_id != b.run_id
+    a.close()
+    b.close()
+
+
+def test_span_sync_on_device_tree(tmp_path):
+    import jax.numpy as jnp
+    tele = Telemetry.memory("unit")
+    sp = tele.span("dev_ms")
+    out = jnp.ones((4, 4)) * 2.0
+    ms = sp.stop(sync=out)  # device-sync-aware stop
+    assert ms >= 0.0
+    assert tele.timer("dev_ms").count == 1
+
+
+# ---- train loop (acceptance: CPU smoke run) ----
+
+@pytest.fixture(scope="module")
+def tele_train(tmp_path_factory):
+    """One tiny telemetry-enabled train run shared by the assertions
+    below (the run itself is the expensive part)."""
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from tests.helpers import build_tiny_dataset
+    from tests.test_model import tiny_config
+
+    d = str(tmp_path_factory.mktemp("tele_train"))
+    prefix = build_tiny_dataset(d, n_train=96, n_val=16, n_test=16,
+                                max_contexts=16)
+    tdir = os.path.join(d, "tele")
+    cfg = tiny_config(prefix, NUM_TRAIN_EPOCHS=2, TELEMETRY_DIR=tdir,
+                      NUM_BATCHES_TO_LOG_PROGRESS=2)
+    model = Code2VecModel(cfg)
+    model.train()
+    return tdir, model
+
+
+def test_train_smoke_writes_manifest_and_step_events(tele_train):
+    tdir, model = tele_train
+    run_dir = _one_run_dir(tdir)
+    with open(os.path.join(run_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["component"] == "train"
+    assert manifest["config"]["MAX_CONTEXTS"] == 16
+    assert manifest["devices"]["count"] >= 1
+    events = _read_events(run_dir)
+    steps = [e for e in events if e["kind"] == "step"]
+    assert len(steps) == 6  # 96 examples / B=32 * 2 epochs
+    for e in steps:
+        assert {"step", "step_ms", "infeed_wait_ms", "loss",
+                "examples"} <= set(e)
+        assert e["step_ms"] >= 0 and e["infeed_wait_ms"] >= 0
+    assert [e["step"] for e in steps] == list(range(1, 7))
+    summary = events[-1]
+    assert summary["kind"] == "summary"
+    assert summary["timers"]["train/step_ms"]["count"] == 6
+    assert summary["counters"]["train/examples"] == 192
+    # train() publishes its run on the model (closed once train ends;
+    # a subsequent serve phase opens its own run)
+    assert model.telemetry.run_id == manifest["run_id"]
+    assert model.telemetry.sinks == []  # closed
+
+
+def test_report_tool_renders_baseline_table_shape(tele_train, capsys):
+    tdir, _model = tele_train
+    report = _load_report_tool()
+    assert report.main([tdir]) == 0
+    out = capsys.readouterr().out
+    # the BASELINE.md shipped-table shape
+    assert "| Config | ms/step | pc/s/chip | vs V100 (1.94M) " in out
+    assert "bag bfloat16 B=32 C=16" in out
+    # per-run detail: timer histogram table
+    assert "| train/step_ms |" in out
+    assert "| train/infeed_wait_ms |" in out
+    assert "run-" in out  # run_id as the Source column
+
+
+def test_report_tool_accepts_single_run_dir(tele_train, capsys):
+    tdir, _model = tele_train
+    report = _load_report_tool()
+    assert report.main([_one_run_dir(tdir)]) == 0
+    assert "| Config |" in capsys.readouterr().out
+
+
+def test_report_tool_errors_on_empty_dir(tmp_path, capsys):
+    report = _load_report_tool()
+    assert report.main([str(tmp_path)]) == 2
+    assert "no telemetry runs" in capsys.readouterr().err
+
+
+def test_train_without_flag_is_disabled_and_writes_nothing(tmp_path):
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from tests.helpers import build_tiny_dataset
+    from tests.test_model import tiny_config
+
+    d = str(tmp_path / "ds")
+    os.makedirs(d)
+    prefix = build_tiny_dataset(d, n_train=64, n_val=8, n_test=8,
+                                max_contexts=16)
+    cfg = tiny_config(prefix, NUM_TRAIN_EPOCHS=1)
+    assert cfg.TELEMETRY_DIR is None
+    model = Code2VecModel(cfg)
+    model.train()
+    # the disabled singleton: no files, no registry growth
+    assert model.telemetry is Telemetry.disabled()
+    assert model.telemetry.timers == {}
+
+
+# ---- serving latency (acceptance: p50/p95/p99 request line) ----
+
+def _scripted_repl(tmp_path, monkeypatch, telemetry_dir=None):
+    from code2vec_tpu.serving.interactive_predict import (
+        InteractivePredictor)
+
+    class StubModel:
+        mesh = None
+
+        def predict(self, lines):
+            return []
+
+    cfg = Config(MAX_CONTEXTS=16)
+    cfg.TELEMETRY_DIR = telemetry_dir
+    input_file = str(tmp_path / "Input.java")
+    with open(input_file, "w") as f:
+        f.write("class A { int f() { return 1; } }\n")
+    pred = InteractivePredictor(cfg, StubModel())
+    monkeypatch.setattr(pred.extractor, "extract_paths",
+                        lambda path: ("A", ["f a,1,b"]))
+    answers = iter(["", "", "q"])
+    monkeypatch.setattr("builtins.input", lambda: next(answers))
+    pred.predict(input_file=input_file)
+    return pred
+
+
+def test_serving_reports_latency_percentiles(tmp_path, monkeypatch,
+                                             capsys):
+    pred = _scripted_repl(tmp_path, monkeypatch)
+    out = capsys.readouterr().out
+    assert "latency: request" in out
+    assert "p50" in out and "p95" in out and "p99" in out
+    assert "over 2 requests" in out
+    # no --telemetry_dir: memory-mode histograms, nothing persisted
+    assert pred.telemetry.enabled and not pred.telemetry.sinks
+    assert pred.telemetry.timer("serve/request_ms").count == 2
+    assert pred.telemetry.timer("serve/extract_ms").count == 2
+
+
+def test_serving_persists_request_events_with_flag(tmp_path,
+                                                   monkeypatch, capsys):
+    tdir = str(tmp_path / "tele")
+    pred = _scripted_repl(tmp_path, monkeypatch, telemetry_dir=tdir)
+    capsys.readouterr()
+    run_dir = _one_run_dir(tdir)
+    with open(os.path.join(run_dir, "manifest.json")) as f:
+        assert json.load(f)["component"] == "serve"
+    events = _read_events(run_dir)
+    requests = [e for e in events if e["kind"] == "request"]
+    assert len(requests) == 2
+    assert all("request_ms" in e and "extract_ms" in e
+               for e in requests)
+    # REPL exit closed the run: summary carries the histograms
+    assert events[-1]["kind"] == "summary"
+    assert events[-1]["timers"]["serve/request_ms"]["count"] == 2
+    assert pred.telemetry.run_dir == run_dir
+
+
+def test_format_latency_line():
+    t = TimerStat()
+    for v in (5.0, 10.0, 20.0):
+        t.record(v)
+    line = format_latency_line(t, 20.0)
+    assert line.startswith("latency: request 20.0 ms")
+    assert "p50" in line and "p99" in line and "over 3 requests" in line
+
+
+# ---- bench / profile emit the shared format ----
+
+def test_bench_emits_telemetry_events(tmp_path, monkeypatch, capsys):
+    import numpy as np
+
+    import bench
+    monkeypatch.setattr(bench, "TOKEN_VOCAB", 128)
+    monkeypatch.setattr(bench, "PATH_VOCAB", 96)
+    monkeypatch.setattr(bench, "TARGET_VOCAB", 64)
+    monkeypatch.setattr(bench, "BATCH", 8)
+    monkeypatch.setattr(bench, "MAX_CONTEXTS", 6)
+    monkeypatch.setattr(bench, "NUM_SAMPLED", 16)
+    monkeypatch.setattr(bench, "WARMUP_STEPS", 1)
+    monkeypatch.setattr(bench, "MEASURE_STEPS", 2)
+    monkeypatch.setattr(bench, "_measure_hbm_ceiling", lambda: 590e9)
+    tdir = str(tmp_path / "tele")
+    bench.main(["--telemetry_dir", tdir])
+    out = capsys.readouterr().out.strip().splitlines()
+    j = json.loads(out[-1])  # the JSON contract line is unchanged
+    assert j["metric"] == "path-contexts/sec/chip"
+    assert np.isfinite(j["value"])
+    run_dir = _one_run_dir(tdir)
+    events = _read_events(run_dir)
+    bench_events = [e for e in events if e["kind"] == "bench"]
+    assert len(bench_events) == 1
+    assert bench_events[0]["value"] == j["value"]
+    assert events[-1]["kind"] == "summary"
+    assert events[-1]["gauges"]["bench/ms_per_step"] == j["ms_per_step"]

@@ -53,12 +53,27 @@ def timeit(fn, sync, steps, warmup=3):
     return (t2 - t1) / (2 * steps)
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    # argv=None (programmatic callers) means "no flags", NOT sys.argv —
+    # the CLI entry below passes sys.argv[1:] explicitly (same contract
+    # as bench.main, so a test calling main() never eats pytest's argv)
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=20)
-    args = ap.parse_args()
+    ap.add_argument("--telemetry_dir", default=None,
+                    help="also emit each phase measurement as telemetry "
+                         "events (code2vec_tpu/obs) so ad-hoc profiling "
+                         "and BENCH rounds share one JSONL format")
+    args = ap.parse_args(argv if argv is not None else [])
     B = args.batch
+
+    from code2vec_tpu.obs import Telemetry
+    tele = Telemetry.create(args.telemetry_dir, component="profile")
+
+    def emit(phase: str, ms: float, **extra) -> None:
+        tele.record_ms(f"profile/{phase}_ms", ms)
+        tele.event("profile", phase=phase, ms=round(ms, 3),
+                   batch=B, **extra)
 
     import jax
     import jax.numpy as jnp
@@ -92,6 +107,9 @@ def main() -> None:
 
     bw = measure_hbm_ceiling()
     print(f"HBM streaming (1 GiB copy): {bw/1e9:.0f} GB/s effective")
+    tele.gauge("profile/hbm_ceiling_gbps", round(bw / 1e9, 1),
+               emit=False)
+    tele.event("profile", phase="hbm_ceiling", gbps=round(bw / 1e9, 1))
 
     # ---- forward only ----
     def loss_fn(params, rng):
@@ -105,12 +123,14 @@ def main() -> None:
     fwd = jax.jit(loss_fn)
     dt = timeit(lambda: fwd(params, rng), lambda o: float(o), args.steps)
     print(f"forward only:        {dt*1e3:6.2f} ms")
+    emit("forward", dt * 1e3)
 
     # ---- forward + backward ----
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
     dt = timeit(lambda: grad_fn(params, rng), lambda o: float(o[0]),
                 args.steps)
     print(f"forward + backward:  {dt*1e3:6.2f} ms")
+    emit("forward_backward", dt * 1e3)
 
     # ---- full step, dense Adam ----
     def run_full(label, step, opt_state0):
@@ -128,6 +148,8 @@ def main() -> None:
         dt = timeit(one, lambda o: float(o), args.steps)
         pc = B * CTX / dt
         print(f"{label}: {dt*1e3:6.2f} ms -> {pc/1e6:.2f}M pc/s")
+        emit(label.replace(" ", "_").replace("(", "").replace(")", ""),
+             dt * 1e3, pc_per_sec=round(pc, 1))
         return dt
 
     from code2vec_tpu.training.optimizers import make_optimizer
@@ -140,6 +162,8 @@ def main() -> None:
                                use_pallas=jax.default_backend() == "tpu")
         run_full(f"full step ({oname})", step, opt.init(params))
 
+    tele.close()
+
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv[1:])

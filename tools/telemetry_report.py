@@ -1,0 +1,258 @@
+#!/usr/bin/env python3
+"""Summarize telemetry runs (code2vec_tpu/obs JSONL) into the
+BASELINE.md table shape.
+
+Usage:
+  python tools/telemetry_report.py <telemetry_dir | run_dir> [run_dir...]
+
+Given `--telemetry_dir`'s root (or one run directory), prints
+
+  - one BASELINE.md-shaped headline table — a row per run with step
+    events: config label, ms/step (p50), pc/s/chip (examples/sec x
+    MAX_CONTEXTS over the instrumented wall: step + infeed wait),
+    vs-V100 ratio (bench.py's denominator), infeed-wait p95, and the
+    run_id as the Source column;
+  - per-run detail tables: every timer histogram (count / mean /
+    p50 / p95 / p99 / max), serving request percentiles, final loss,
+    gauges, and any bench/profile events the run carried.
+
+Pure stdlib + the repo's own modules; reads only the manifest + events
+files, so it works on a laptop over a run dir scp'd from a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+PCTS = (50, 95, 99)
+
+
+def _v100_denominator() -> float:
+    """bench.py's baseline denominator — imported lazily so this tool
+    stays runnable on a machine without the repo's deps (bench pulls in
+    numpy at module scope); the fallback is bench.py's pinned literal
+    (BASELINE.md "Baseline denominator")."""
+    try:
+        from bench import V100_BASELINE_PATH_CONTEXTS_PER_SEC
+        return V100_BASELINE_PATH_CONTEXTS_PER_SEC
+    except Exception:
+        return 1_940_000.0
+
+
+def find_runs(path: str) -> List[str]:
+    """`path` is one run dir (has manifest.json) or a telemetry root
+    (run dirs one level down), newest first."""
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        return [path]
+    runs = [os.path.join(path, d)
+            for d in sorted(os.listdir(path), reverse=True)
+            if os.path.exists(os.path.join(path, d, "manifest.json"))]
+    return runs
+
+
+def load_run(run_dir: str):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as f:
+        manifest = json.load(f)
+    events: List[Dict[str, Any]] = []
+    ev_path = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(ev_path):
+        with open(ev_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    return manifest, events
+
+
+def _pct(values: List[float], p: float) -> float:
+    if not values:
+        return float("nan")
+    s = sorted(values)
+    k = int(round(p / 100.0 * (len(s) - 1)))
+    return s[max(0, min(len(s) - 1, k))]
+
+
+def _config_label(manifest: Dict[str, Any]) -> str:
+    cfg = manifest.get("config") or {}
+    bits = [manifest.get("component", "run")]
+    if cfg:
+        bits.append(cfg.get("ENCODER_TYPE", "?"))
+        bits.append(str(cfg.get("TABLES_DTYPE", "?")))
+        bits.append(f"B={cfg.get('TRAIN_BATCH_SIZE', '?')}")
+        bits.append(f"C={cfg.get('MAX_CONTEXTS', '?')}")
+    mesh = manifest.get("mesh")
+    if mesh:
+        bits.append("mesh=" + "x".join(str(v) for v in mesh.values()))
+    return " ".join(bits)
+
+
+def summarize_steps(manifest: Dict[str, Any],
+                    events: List[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    steps = [e for e in events if e.get("kind") == "step"]
+    if not steps:
+        return None
+    step_ms = [float(e["step_ms"]) for e in steps if "step_ms" in e]
+    wait_ms = [float(e.get("infeed_wait_ms", 0.0)) for e in steps]
+    examples = sum(int(e.get("examples", 0)) for e in steps)
+    total_s = (sum(step_ms) + sum(wait_ms)) / 1e3
+    cfg = manifest.get("config") or {}
+    max_contexts = int(cfg.get("MAX_CONTEXTS", 0) or 0)
+    ex_s = examples / total_s if total_s > 0 else float("nan")
+    pc_s = ex_s * max_contexts if max_contexts else float("nan")
+    return {
+        "n_steps": len(steps),
+        "ms_per_step_p50": _pct(step_ms, 50),
+        "step_ms": step_ms,
+        "infeed_wait_ms": wait_ms,
+        "examples": examples,
+        "ex_per_sec": ex_s,
+        "pc_per_sec": pc_s,
+        "vs_v100": (pc_s / _v100_denominator()
+                    if pc_s == pc_s else float("nan")),
+        "final_loss": next((e.get("loss") for e in reversed(steps)
+                            if "loss" in e), None),
+    }
+
+
+def _timer_rows(events: List[Dict[str, Any]]) -> Dict[str, Dict]:
+    """Timer summaries: the close()-time `summary` event when present
+    (it has every registry timer), else recomputed from raw events."""
+    for e in reversed(events):
+        if e.get("kind") == "summary" and e.get("timers"):
+            return dict(e["timers"])
+    # fallback: rebuild from per-event samples
+    samples: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("kind") == "step":
+            samples.setdefault("train/step_ms", []).append(
+                float(e.get("step_ms", 0.0)))
+            samples.setdefault("train/infeed_wait_ms", []).append(
+                float(e.get("infeed_wait_ms", 0.0)))
+        elif e.get("kind") == "request":
+            samples.setdefault("serve/request_ms", []).append(
+                float(e.get("request_ms", 0.0)))
+        elif e.get("kind") == "profile" and "ms" in e:
+            samples.setdefault(f"profile/{e.get('phase')}_ms",
+                               []).append(float(e["ms"]))
+    out = {}
+    for name, vals in sorted(samples.items()):
+        row = {"count": len(vals),
+               "mean_ms": sum(vals) / len(vals),
+               "max_ms": max(vals)}
+        for p in PCTS:
+            row[f"p{p}_ms"] = _pct(vals, p)
+        out[name] = row
+    return out
+
+
+def _fmt(v, nd: int = 2) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        if v != v:  # nan
+            return "—"
+        return f"{v:,.{nd}f}"
+    return str(v)
+
+
+def render(run_dirs: List[str]) -> str:
+    loaded = [(d, *load_run(d)) for d in run_dirs]
+    lines: List[str] = []
+
+    # ---- headline: the BASELINE.md shipped-table shape ----
+    head = [(d, m, ev, summarize_steps(m, ev)) for d, m, ev in loaded]
+    train_rows = [(d, m, ev, s) for d, m, ev, s in head if s]
+    if train_rows:
+        lines.append("| Config | ms/step | pc/s/chip | vs V100 (1.94M) "
+                     "| infeed wait p95 (ms) | steps | Source |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for _d, m, _ev, s in train_rows:
+            lines.append(
+                f"| {_config_label(m)} "
+                f"| {_fmt(s['ms_per_step_p50'])} "
+                f"| {_fmt(s['pc_per_sec'], 1)} "
+                f"| {_fmt(s['vs_v100'], 3)} "
+                f"| {_fmt(_pct(s['infeed_wait_ms'], 95))} "
+                f"| {s['n_steps']} "
+                f"| {m.get('run_id', '?')} |")
+        lines.append("")
+
+    # ---- per-run detail ----
+    for _d, manifest, events, step_summary in head:
+        rid = manifest.get("run_id", "?")
+        dev = manifest.get("devices") or {}
+        lines.append(f"## run {rid} ({manifest.get('component', '?')}, "
+                     f"{dev.get('platform', '?')} x"
+                     f"{dev.get('count', '?')}, "
+                     f"process {manifest.get('process_index', 0)}"
+                     f"/{manifest.get('process_count', 1)})")
+        if step_summary:
+            lines.append(f"- steps: {step_summary['n_steps']}, "
+                         f"examples: {step_summary['examples']}, "
+                         f"final loss: "
+                         f"{_fmt(step_summary['final_loss'], 4)}, "
+                         f"{_fmt(step_summary['ex_per_sec'], 1)} ex/s")
+        timers = _timer_rows(events)
+        if timers:
+            lines.append("")
+            lines.append("| Timer | count | mean ms | p50 | p95 | p99 "
+                         "| max |")
+            lines.append("|---|---|---|---|---|---|---|")
+            for name, t in sorted(timers.items()):
+                lines.append(
+                    f"| {name} | {t.get('count', 0)} "
+                    f"| {_fmt(t.get('mean_ms'))} "
+                    f"| {_fmt(t.get('p50_ms'))} "
+                    f"| {_fmt(t.get('p95_ms'))} "
+                    f"| {_fmt(t.get('p99_ms'))} "
+                    f"| {_fmt(t.get('max_ms'))} |")
+        gauges = {}
+        for e in events:
+            if e.get("kind") == "gauge":
+                gauges[e.get("name")] = e.get("value")
+            elif e.get("kind") == "summary" and e.get("gauges"):
+                gauges.update(e["gauges"])
+        if gauges:
+            lines.append("")
+            lines.append("gauges: " + ", ".join(
+                f"{k}={_fmt(v, 1)}" for k, v in sorted(gauges.items())))
+        bench_events = [e for e in events if e.get("kind") == "bench"]
+        for b in bench_events:
+            lines.append("")
+            lines.append(
+                f"bench: {_fmt(b.get('value'), 1)} {b.get('metric')} "
+                f"({_fmt(b.get('vs_baseline'), 3)}x V100, "
+                f"{_fmt(b.get('ms_per_step'))} ms/step)")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize code2vec_tpu telemetry JSONL runs")
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry root dir(s) or run dir(s)")
+    args = ap.parse_args(argv)
+    run_dirs: List[str] = []
+    for p in args.paths:
+        found = find_runs(p)
+        if not found:
+            print(f"error: no telemetry runs under {p}",
+                  file=sys.stderr)
+            return 2
+        run_dirs.extend(found)
+    sys.stdout.write(render(run_dirs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
