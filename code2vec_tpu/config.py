@@ -128,6 +128,34 @@ class Config:
     # transfer round trip); single-device only — ignored with a mesh.
     INFEED_CHUNK: int = 1
 
+    # ---- batched serving (serving/server.py + serving/batcher.py):
+    # a thread-safe request queue feeding a dynamic micro-batcher that
+    # coalesces concurrent predict requests into the power-of-two
+    # buckets the jitted predict step compiles, an LRU prediction
+    # cache, and bounded-queue admission control. ----
+    # Max methods per coalesced device batch. Must be a power of two:
+    # it is the largest warmed shape bucket, so steady-state serving
+    # never triggers a new jit compilation.
+    SERVE_BATCH_MAX: int = 64
+    # Coalescing window: after the first queued request, wait at most
+    # this long for more before flushing (Clipper-style deadline batch).
+    # 0 = greedy drain-and-flush (batches still form while the device
+    # is busy). Small values keep the idle REPL's latency unchanged.
+    SERVE_BATCH_TIMEOUT_MS: float = 2.0
+    # Admission control: bounded request queue; submissions beyond this
+    # depth are refused immediately with ServerOverloaded.
+    SERVE_QUEUE_DEPTH: int = 128
+    # Per-request deadline: a request still queued past this is shed
+    # with ServerOverloaded instead of growing the tail. 0 = none.
+    SERVE_DEADLINE_MS: float = 2000.0
+    # LRU prediction cache entries (one per normalized path-context
+    # bag); hits skip encode + device entirely. 0 disables.
+    SERVE_CACHE_SIZE: int = 1024
+    # Persistent extractor worker pool size (serving/extractor.py):
+    # in-process libc2v when built, else one subprocess per file but
+    # never a fresh pool spawn per request.
+    SERVE_EXTRACT_WORKERS: int = 2
+
     # ---- encoder architecture: "bag" (reference parity) or
     # "transformer" (set transformer over the contexts,
     # models/transformer_encoder.py; BASELINE.json configs[4]). ----
@@ -397,6 +425,32 @@ class Config:
                             "infeed_wait_ms / loss, device-memory "
                             "gauges, serving latency); summarize with "
                             "tools/telemetry_report.py")
+        p.add_argument("--serve_batch_max", dest="serve_batch_max",
+                       type=int, default=None,
+                       help="max methods per coalesced serving batch "
+                            "(power of two; the largest warmed predict "
+                            "bucket)")
+        p.add_argument("--serve_batch_timeout_ms",
+                       dest="serve_batch_timeout_ms", type=float,
+                       default=None,
+                       help="micro-batcher coalescing window in ms "
+                            "(0 = greedy flush)")
+        p.add_argument("--serve_queue_depth", dest="serve_queue_depth",
+                       type=int, default=None,
+                       help="bounded request queue depth; beyond it "
+                            "submissions shed with ServerOverloaded")
+        p.add_argument("--serve_deadline_ms", dest="serve_deadline_ms",
+                       type=float, default=None,
+                       help="per-request deadline in ms; queued past it "
+                            "the request is shed (0 = none)")
+        p.add_argument("--serve_cache_size", dest="serve_cache_size",
+                       type=int, default=None,
+                       help="LRU prediction cache entries keyed by the "
+                            "normalized path-context bag (0 = off)")
+        p.add_argument("--serve_extract_workers",
+                       dest="serve_extract_workers", type=int,
+                       default=None,
+                       help="persistent extractor worker pool size")
         p.add_argument("--attack", dest="attack", default=None,
                        choices=["targeted", "untargeted"],
                        help="gradient-guided variable-rename attack on "
@@ -520,6 +574,18 @@ class Config:
             cfg.TENSORBOARD_DIR = ns.tensorboard_dir
         if ns.telemetry_dir is not None:
             cfg.TELEMETRY_DIR = ns.telemetry_dir
+        if ns.serve_batch_max is not None:
+            cfg.SERVE_BATCH_MAX = ns.serve_batch_max
+        if ns.serve_batch_timeout_ms is not None:
+            cfg.SERVE_BATCH_TIMEOUT_MS = ns.serve_batch_timeout_ms
+        if ns.serve_queue_depth is not None:
+            cfg.SERVE_QUEUE_DEPTH = ns.serve_queue_depth
+        if ns.serve_deadline_ms is not None:
+            cfg.SERVE_DEADLINE_MS = ns.serve_deadline_ms
+        if ns.serve_cache_size is not None:
+            cfg.SERVE_CACHE_SIZE = ns.serve_cache_size
+        if ns.serve_extract_workers is not None:
+            cfg.SERVE_EXTRACT_WORKERS = ns.serve_extract_workers
         if ns.attack is not None:
             cfg.ATTACK = ns.attack
         if ns.attack_target is not None:
@@ -616,6 +682,24 @@ class Config:
                     "--attack needs float/bf16 tables (the gradient "
                     "attack's candidate matvec reads the table as one "
                     "array); rerun with a bf16 checkpoint.")
+        if self.SERVE_BATCH_MAX < 1 or (
+                self.SERVE_BATCH_MAX & (self.SERVE_BATCH_MAX - 1)):
+            # power of two so the batcher's flush cap IS the largest
+            # warmed predict bucket — otherwise steady-state serving
+            # would jit-compile an unwarmed shape under load
+            raise ValueError(
+                "--serve_batch_max must be a power of two "
+                f"(got {self.SERVE_BATCH_MAX}).")
+        if self.SERVE_BATCH_TIMEOUT_MS < 0:
+            raise ValueError("--serve_batch_timeout_ms must be >= 0.")
+        if self.SERVE_QUEUE_DEPTH < 1:
+            raise ValueError("--serve_queue_depth must be >= 1.")
+        if self.SERVE_DEADLINE_MS < 0:
+            raise ValueError("--serve_deadline_ms must be >= 0.")
+        if self.SERVE_CACHE_SIZE < 0:
+            raise ValueError("--serve_cache_size must be >= 0.")
+        if self.SERVE_EXTRACT_WORKERS < 1:
+            raise ValueError("--serve_extract_workers must be >= 1.")
         if self.LR_WARMUP_STEPS < 0:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:

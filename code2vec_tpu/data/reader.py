@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Iterator, List, NamedTuple, Optional
 
 import numpy as np
@@ -75,12 +76,21 @@ def parse_c2v_rows(lines: List[str], vocabs: Code2VecVocabs,
             # the max_contexts slots
             real = [c for c in ctxs if c and c != ",,"]
             if len(real) > max_contexts:
-                # per-row seed: a row's sample must not depend on which
-                # other over-cap rows precede it in the batch
-                rng = np.random.default_rng((sample_seed, i))
-                pick = np.sort(rng.choice(len(real), size=max_contexts,
+                # sample from the row's SORTED context bag with a seed
+                # derived from that same bag — not from batch position
+                # or context order: the same method must keep the same
+                # contexts wherever (and however ordered) it appears,
+                # so the serving cache — keyed by exactly this
+                # normalized bag — stays deterministic. The bag encoder
+                # is order-invariant, so emitting the sample in sorted
+                # order loses nothing.
+                canon = sorted(real)
+                rng = np.random.default_rng(
+                    (sample_seed,
+                     zlib.crc32(" ".join(canon).encode("utf-8"))))
+                pick = np.sort(rng.choice(len(canon), size=max_contexts,
                                           replace=False))
-                real = [real[k] for k in pick]
+                real = [canon[k] for k in pick]
             ctxs = real
         if keep_strings:
             target_strings.append(target)

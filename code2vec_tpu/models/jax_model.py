@@ -10,8 +10,9 @@ orchestration only.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Iterable, List, Optional
+from typing import Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,52 @@ from code2vec_tpu.training.profiler import StepProfiler
 from code2vec_tpu.training.steps import (make_encode_step, make_eval_step,
                                          make_predict_step, make_train_step)
 from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, VocabType
+
+
+@dataclasses.dataclass
+class PreparedRows:
+    """Pre-parsed predict rows (the host half of `predict`): one row per
+    method, un-padded leading dim. The serving micro-batcher coalesces
+    several requests' rows with `concat` and runs ONE bucketed device
+    call (`predict_prepared`), so parsing stays on the client threads
+    and the device sees power-of-two batches only."""
+
+    labels: "np.ndarray"
+    src: "np.ndarray"
+    pth: "np.ndarray"
+    dst: "np.ndarray"
+    mask: "np.ndarray"
+    target_strings: List[str]
+    context_strings: List[List[str]]
+
+    @property
+    def n(self) -> int:
+        return int(self.labels.shape[0])
+
+    def slice(self, start: int, stop: int) -> "PreparedRows":
+        """Row slice [start, stop) — numpy views, no copy. Used to
+        chunk an oversized request to the batcher's max_batch."""
+        if start == 0 and stop >= self.n:
+            return self
+        return PreparedRows(
+            self.labels[start:stop], self.src[start:stop],
+            self.pth[start:stop], self.dst[start:stop],
+            self.mask[start:stop], self.target_strings[start:stop],
+            self.context_strings[start:stop])
+
+    @staticmethod
+    def concat(items: Sequence["PreparedRows"]) -> "PreparedRows":
+        assert items
+        if len(items) == 1:
+            return items[0]
+        return PreparedRows(
+            labels=np.concatenate([p.labels for p in items]),
+            src=np.concatenate([p.src for p in items]),
+            pth=np.concatenate([p.pth for p in items]),
+            dst=np.concatenate([p.dst for p in items]),
+            mask=np.concatenate([p.mask for p in items]),
+            target_strings=[s for p in items for s in p.target_strings],
+            context_strings=[c for p in items for c in p.context_strings])
 
 
 class Code2VecModel(Code2VecModelBase):
@@ -414,29 +461,81 @@ class Code2VecModel(Code2VecModelBase):
         return acc.results()
 
     # ---- predict raw extractor lines (SURVEY.md §4.4) ----
-    def predict(self, predict_data_lines: Iterable[str]
-                ) -> List[MethodPredictionResults]:
-        cfg = self.config
+    def prepare_predict_rows(self, predict_data_lines: Iterable[str]
+                             ) -> PreparedRows:
+        """Host half of `predict`: raw extractor lines -> un-padded
+        per-method index rows. Pure host work — the serving layer runs
+        this on client threads so the batcher thread only touches the
+        device. Timed as `serve/parse_ms` (the pre-split `encode_ms`
+        covered parse + pad; the phases now report separately)."""
+        parse_span = self.telemetry.span("serve/parse_ms")
         lines = [ln for ln in predict_data_lines if ln.strip()]
-        if not lines:
-            return []
-        # host phase: raw lines -> padded tensors (serve/encode_ms)
-        encode_span = self.telemetry.span("serve/encode_ms")
         labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
-            lines, self.vocabs, cfg.MAX_CONTEXTS, keep_strings=True)
-        n = len(lines)
-        # Pad the leading dim to the next power of two: the jitted predict
-        # step compiles O(log n) variants instead of one per method count.
+            lines, self.vocabs, self.config.MAX_CONTEXTS,
+            keep_strings=True)
+        parse_span.stop()
+        return PreparedRows(labels, src, pth, dst, mask, tstr, cstr)
+
+    def predict_bucket_size(self, n: int) -> int:
+        """Padded leading dim for an `n`-method predict batch: the next
+        power of two (the jitted step compiles O(log n) variants instead
+        of one per method count), rounded up to a multiple of the data
+        axis when a mesh shards the batch."""
         padded_n = max(1, 1 << (n - 1).bit_length())
         if self.mesh is not None:
             # batch dim must divide the data axis to shard over the mesh
             # batch shards over ('dcn','data') jointly
             dax = self.mesh.shape[DATA_AXIS] * self.mesh.shape[DCN_AXIS]
             padded_n = -(-padded_n // dax) * dax
+        return padded_n
+
+    def warmup_predict(self, max_batch: int) -> List[int]:
+        """Pre-compile the predict step's shape buckets up to (and
+        including) `max_batch`'s bucket, so steady-state serving
+        triggers zero new jit compilations. Returns the bucket sizes."""
+        buckets = sorted({self.predict_bucket_size(n)
+                          for n in [1 << i for i in range(
+                              max(1, max_batch).bit_length())]
+                          + [max(1, max_batch)]})
+        for b in buckets:
+            batch = (np.zeros((b,), np.int32),
+                     np.zeros((b, self.dims.max_contexts), np.int32),
+                     np.zeros((b, self.dims.max_contexts), np.int32),
+                     np.zeros((b, self.dims.max_contexts), np.int32),
+                     np.zeros((b, self.dims.max_contexts), np.float32),
+                     np.zeros((b,), np.float32))
+            if self.mesh is not None:
+                batch = shard_batch(self.mesh, batch, process_local=False)
+            out = self._predict_step(self.params, batch)
+            jax.block_until_ready(out)
+        return buckets
+
+    def predict_compile_count(self) -> int:
+        """Number of compiled predict-step variants (-1 when the
+        backend's jit cache is not introspectable). Serving asserts this
+        stays flat after `warmup_predict` — the zero-new-compilations
+        acceptance check."""
+        try:
+            return int(self._predict_step._cache_size())
+        except Exception:
+            return -1
+
+    def predict_device(self, prepared: PreparedRows):
+        """Device phase of `predict`: pad the rows to their
+        power-of-two bucket, run the jitted step once, fetch. Returns
+        host arrays `(topk_ids, topk_probs, attention, code)` trimmed
+        to `prepared.n` rows — decoding is a separate host phase
+        (`decode_predictions`) so the serving batcher can fan it out to
+        client threads instead of serializing it after every batch."""
+        n = prepared.n
+        # host phase: rows -> padded device batch (serve/encode_ms)
+        encode_span = self.telemetry.span("serve/encode_ms")
+        padded_n = self.predict_bucket_size(n)
         weights = np.zeros((padded_n,), dtype=np.float32)
         weights[:n] = 1.0
         labels, src, pth, dst, mask = _pad_batch(
-            (labels, src, pth, dst, mask), padded_n)
+            (prepared.labels, prepared.src, prepared.pth, prepared.dst,
+             prepared.mask), padded_n)
         batch = (labels, src, pth, dst, mask, weights)
         if self.mesh is not None:
             batch = shard_batch(self.mesh, batch, process_local=False)
@@ -446,13 +545,22 @@ class Code2VecModel(Code2VecModelBase):
         predict_span = self.telemetry.span("serve/predict_ms")
         topk_ids, topk_probs, attn, code = self._predict_step(
             self.params, batch)
-        topk_ids = fetch_global(topk_ids)
-        topk_probs = fetch_global(topk_probs)
-        attn = fetch_global(attn)
-        code = fetch_global(code)
+        topk_ids = fetch_global(topk_ids)[:n]
+        topk_probs = fetch_global(topk_probs)[:n]
+        attn = fetch_global(attn)[:n]
+        code = fetch_global(code)[:n]
         predict_span.stop()
+        return topk_ids, topk_probs, attn, code
+
+    def decode_predictions(self, prepared: PreparedRows, device_out
+                           ) -> List[MethodPredictionResults]:
+        """Host decode of `predict_device` output rows (row i of
+        `device_out` is row i of `prepared`): vocab lookups + the
+        attention-ranked path-contexts for interpretability."""
+        cfg = self.config
+        topk_ids, topk_probs, attn, code = device_out
         results = []
-        for i, original in enumerate(tstr):
+        for i, original in enumerate(prepared.target_strings):
             res = MethodPredictionResults(original_name=original)
             for j in range(topk_ids.shape[1]):
                 word = self.vocabs.target_vocab.lookup_word(
@@ -461,10 +569,10 @@ class Code2VecModel(Code2VecModelBase):
                     continue
                 res.append_prediction(word, float(topk_probs[i, j]))
             # attention-ranked path-contexts for interpretability
-            ctx_fields = cstr[i]
+            ctx_fields = prepared.context_strings[i]
             order = np.argsort(-attn[i])
             for j in order:
-                if j >= len(ctx_fields) or mask[i, j] == 0:
+                if j >= len(ctx_fields) or prepared.mask[i, j] == 0:
                     continue
                 parts = ctx_fields[j].split(",")
                 if len(parts) != 3:
@@ -475,6 +583,22 @@ class Code2VecModel(Code2VecModelBase):
                 res.code_vector = code[i]
             results.append(res)
         return results
+
+    def predict_prepared(self, prepared: PreparedRows
+                         ) -> List[MethodPredictionResults]:
+        """Single-caller form: device phase + decode in one call.
+        Accepts pre-parsed (possibly concatenated) rows."""
+        if prepared.n == 0:
+            return []
+        return self.decode_predictions(prepared,
+                                       self.predict_device(prepared))
+
+    def predict(self, predict_data_lines: Iterable[str]
+                ) -> List[MethodPredictionResults]:
+        prepared = self.prepare_predict_rows(predict_data_lines)
+        if prepared.n == 0:
+            return []
+        return self.predict_prepared(prepared)
 
     # ---- persistence ----
     def save(self, path: Optional[str] = None) -> None:

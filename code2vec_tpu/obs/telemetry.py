@@ -27,8 +27,12 @@ disabled path (`--telemetry_dir` unset) is a shared singleton whose
 `enabled` is False — hot loops guard on that ONE boolean and allocate
 nothing per step.
 
-Not thread-safe: record from the loop thread that owns the instance
-(the infeed producer thread never touches telemetry).
+Not thread-safe by default: record from the loop thread that owns the
+instance (the infeed producer thread never touches telemetry). The
+serving subsystem is the exception — client threads, the extractor
+pool, and the batcher thread all record into one registry — so
+`make_threadsafe()` installs an RLock around the mutating surface;
+the train loop keeps the lock-free fast path.
 """
 
 from __future__ import annotations
@@ -37,6 +41,7 @@ import contextlib
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence
 
@@ -99,7 +104,9 @@ class TimerStat:
         """Nearest-rank percentile over the sample window."""
         if not self._ring:
             return float("nan")
-        s = sorted(self._ring)
+        # snapshot first: serving reads percentiles while other threads
+        # record (GIL makes the copy itself safe)
+        s = sorted(list(self._ring))
         k = int(round(p / 100.0 * (len(s) - 1)))
         return s[max(0, min(len(s) - 1, k))]
 
@@ -203,6 +210,24 @@ class Telemetry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.timers: Dict[str, TimerStat] = {}
+        # None = lock-free fast path (the train loop); serving calls
+        # make_threadsafe() because many threads share one registry
+        self._lock: Optional[threading.RLock] = None
+
+    def make_threadsafe(self) -> "Telemetry":
+        """Install an RLock around the mutating surface (count / gauge /
+        record_ms / event / summary / close). Returns self, so call
+        sites can chain: `Telemetry.memory("serve").make_threadsafe()`."""
+        if self._lock is None:
+            self._lock = threading.RLock()
+        return self
+
+    # shared stateless instance: the lock-free path must not allocate
+    # a context manager per record
+    _NO_LOCK = contextlib.nullcontext()
+
+    def _guard(self):
+        return self._lock if self._lock is not None else self._NO_LOCK
 
     # ---- construction ----
     @classmethod
@@ -248,21 +273,25 @@ class Telemetry:
 
     # ---- recording ----
     def count(self, name: str, n: float = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._guard():
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def gauge(self, name: str, value: float, emit: bool = True) -> None:
-        self.gauges[name] = value
+        with self._guard():
+            self.gauges[name] = value
         if emit:
             self.event("gauge", name=name, value=value)
 
     def timer(self, name: str) -> TimerStat:
-        t = self.timers.get(name)
-        if t is None:
-            t = self.timers[name] = TimerStat()
-        return t
+        with self._guard():
+            t = self.timers.get(name)
+            if t is None:
+                t = self.timers[name] = TimerStat()
+            return t
 
     def record_ms(self, name: str, ms: float) -> None:
-        self.timer(name).record(ms)
+        with self._guard():
+            self.timer(name).record(ms)
 
     def span(self, name: str) -> _Span:
         """Start a host-monotonic span; `stop()` records it, and
@@ -289,24 +318,27 @@ class Telemetry:
             return
         ev: Dict[str, Any] = {"kind": kind, "ts": round(time.time(), 6)}
         ev.update(fields)
-        for s in self.sinks:
-            s.write(ev)
+        with self._guard():
+            for s in self.sinks:
+                s.write(ev)
 
     # ---- lifecycle ----
     def summary(self) -> Dict[str, Any]:
-        return {"counters": dict(self.counters),
-                "gauges": dict(self.gauges),
-                "timers": {k: t.summary()
-                           for k, t in sorted(self.timers.items())}}
+        with self._guard():
+            return {"counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "timers": {k: t.summary()
+                               for k, t in sorted(self.timers.items())}}
 
     def close(self) -> None:
         if not self.enabled:
             return
         if self.sinks:
             self.event("summary", **self.summary())
-        for s in self.sinks:
-            s.close()
-        self.sinks = []
+        with self._guard():
+            for s in self.sinks:
+                s.close()
+            self.sinks = []
 
 
 class _NullTelemetry(Telemetry):

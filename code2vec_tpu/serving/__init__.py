@@ -1,0 +1,18 @@
+"""code2vec_tpu.serving — the serving surface (ISSUE 3).
+
+`PredictionServer` (server.py) is the batched entry point: request
+queue -> dynamic micro-batcher (batcher.py) -> bucketed device batches,
+with an LRU prediction cache, bounded-queue admission control, and a
+persistent extractor worker pool (extractor.py). The interactive REPL
+(interactive_predict.py) and the load generator (tools/loadgen.py) are
+thin clients of it.
+"""
+
+from code2vec_tpu.serving.batcher import (MicroBatcher,  # noqa: F401
+                                          PredictRequest,
+                                          ServerOverloaded)
+from code2vec_tpu.serving.extractor import (Extractor,  # noqa: F401
+                                            ExtractorError,
+                                            ExtractorPool)
+from code2vec_tpu.serving.server import (PredictionCache,  # noqa: F401
+                                         PredictionServer, normalize_bag)

@@ -1,0 +1,249 @@
+"""Dynamic micro-batching for the prediction server (ISSUE 3).
+
+Clipper-style adaptive batching (Crankshaw et al., NSDI 2017): concurrent
+predict requests land in a bounded queue; a single batcher thread
+coalesces them into one device batch, flushing on `--serve_batch_max`
+total methods or a `--serve_batch_timeout_ms` deadline — whichever comes
+first. The batch then pads to the power-of-two buckets the model's
+jitted predict step already compiles, so steady-state serving triggers
+zero new compilations (serving/server.py warms the buckets up front).
+
+Admission control is explicit, not emergent: `submit()` on a full queue
+returns False immediately (the caller sheds with `ServerOverloaded`),
+and requests whose deadline expired while queued are shed at dequeue
+time — bounded latency instead of unbounded queue growth.
+
+This module is model-agnostic and stdlib-only: requests carry an opaque
+`rows` payload plus its leading-dim size `n`; the server supplies
+`batch_fn(requests) -> per-request results`. That keeps the
+queue/deadline/flush logic unit-testable without jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+__all__ = ["ServerOverloaded", "PredictRequest", "MicroBatcher"]
+
+
+class ServerOverloaded(RuntimeError):
+    """Explicit load-shedding result: the request was refused (queue
+    full) or dropped (deadline expired before it reached the device).
+    Clients see this instead of unbounded latency growth."""
+
+
+class PredictRequest:
+    """One in-flight predict request: an opaque `rows` payload (the
+    server passes pre-parsed `PreparedRows`), its leading-dim size `n`,
+    and an absolute monotonic `deadline` (None = no deadline). The
+    submitting thread blocks on `wait()`; the batcher thread resolves it
+    via `finish()` / `fail()`."""
+
+    __slots__ = ("rows", "n", "deadline", "enqueued_at", "result",
+                 "error", "_done", "_lock")
+
+    def __init__(self, rows: Any, n: int,
+                 deadline: Optional[float] = None):
+        assert n >= 1, "empty requests never reach the batcher"
+        self.rows = rows
+        self.n = n
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    def finish(self, result: Any) -> bool:
+        # first resolution wins: a late batch result must not clobber a
+        # timeout the waiter already acted on (and vice versa). Returns
+        # whether THIS call resolved the request.
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self.result = result
+            self._done.set()
+            return True
+
+    def fail(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self.error = error
+            self._done.set()
+            return True
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """True when resolved; False on timeout (the batcher may still
+        resolve it later — the caller decides whether to keep waiting)."""
+        return self._done.wait(timeout)
+
+
+class MicroBatcher:
+    """Single consumer thread over a bounded request queue.
+
+    Flush policy (`_collect`): block for the first request, open a
+    `timeout_ms` coalescing window, and keep admitting queued requests
+    until the batch holds `max_batch` methods or the window closes.
+    `timeout_ms=0` degenerates to greedy drain-and-flush (lowest
+    latency; batches still form naturally while the device is busy).
+    A request whose methods would overflow `max_batch` stays queued for
+    the next batch — request payloads are never split.
+    """
+
+    def __init__(self, batch_fn: Callable[[Sequence[PredictRequest]],
+                                          Sequence[Any]],
+                 *, max_batch: int = 64, timeout_ms: float = 2.0,
+                 queue_depth: int = 128, telemetry=None):
+        assert max_batch >= 1 and queue_depth >= 1 and timeout_ms >= 0
+        self._batch_fn = batch_fn
+        self.max_batch = max_batch
+        self.timeout_s = timeout_ms / 1e3
+        self.queue_depth = queue_depth
+        from code2vec_tpu.obs import Telemetry
+        self._tele = telemetry if telemetry is not None \
+            else Telemetry.disabled()
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        with self._cond:  # atomic check-then-act: one consumer thread,
+            if self._running:  # ever, under concurrent first requests
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._run, name="micro-batcher", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the consumer; queued-but-unserved requests are failed
+        with `ServerOverloaded` so no submitter blocks forever."""
+        with self._cond:
+            if not self._running:
+                return
+            self._running = False
+            pending = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for req in pending:
+            req.fail(ServerOverloaded("server shutting down"))
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ---- producer side ----
+    def submit(self, req: PredictRequest) -> bool:
+        """Enqueue; False when the bounded queue is full (admission
+        control — the caller sheds with `ServerOverloaded`)."""
+        if req.n > self.max_batch:
+            # an oversized payload would flush as an unwarmed jit
+            # bucket, breaking the zero-steady-state-compilation
+            # invariant — callers chunk first (server.predict_lines)
+            raise ValueError(
+                f"request of {req.n} methods exceeds max_batch "
+                f"{self.max_batch}; split it before submitting")
+        with self._cond:
+            if not self._running:
+                return False
+            if len(self._q) >= self.queue_depth:
+                return False
+            self._q.append(req)
+            depth = len(self._q)
+            self._cond.notify()
+        self._tele.gauge("serve/queue_depth", depth, emit=False)
+        return True
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    # ---- consumer side ----
+    def _collect(self) -> List[PredictRequest]:
+        """One flush: first request (blocking) + coalescing window."""
+        with self._cond:
+            while self._running and not self._q:
+                self._cond.wait()
+            if not self._running:
+                return []
+            batch = [self._q.popleft()]
+            n = batch[0].n
+            flush_at = time.monotonic() + self.timeout_s
+            while n < self.max_batch:
+                if self._q:
+                    if n + self._q[0].n > self.max_batch:
+                        break  # would overflow: leave for the next batch
+                    req = self._q.popleft()
+                    batch.append(req)
+                    n += req.n
+                    continue
+                remaining = flush_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+                if not self._running:
+                    break
+            # keep the gauge honest on the drain side too — submit-only
+            # updates would freeze it at the last high-water mark
+            depth = len(self._q)
+        self._tele.gauge("serve/queue_depth", depth, emit=False)
+        return batch
+
+    def _shed_expired(self, batch: List[PredictRequest]
+                      ) -> List[PredictRequest]:
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            if req.done:
+                # already resolved by its waiter (timeout, or a sibling
+                # chunk's refusal) — don't spend device time on it
+                continue
+            if req.deadline is not None and now > req.deadline:
+                if req.fail(ServerOverloaded(
+                        f"deadline exceeded after "
+                        f"{(now - req.enqueued_at) * 1e3:.0f} ms in "
+                        f"queue")):
+                    # count only when OUR fail resolved it — the
+                    # waiter's timeout path counts its own shed
+                    self._tele.count("serve/shed")
+            else:
+                live.append(req)
+        return live
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch and not self._running:
+                return
+            batch = self._shed_expired(batch)
+            if not batch:
+                continue
+            n = sum(r.n for r in batch)
+            self._tele.count("serve/batches")
+            self._tele.record_ms("serve/batch_methods", float(n))
+            self._tele.gauge("serve/batch_occupancy",
+                             round(n / self.max_batch, 4), emit=False)
+            try:
+                results = self._batch_fn(batch)
+            except BaseException as e:  # noqa: BLE001 — forwarded, not hidden
+                for req in batch:
+                    req.fail(e)
+                continue
+            assert len(results) == len(batch), (
+                "batch_fn must return one result per request")
+            for req, res in zip(batch, results):
+                req.finish(res)

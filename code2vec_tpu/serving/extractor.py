@@ -1,14 +1,23 @@
-"""Single-file extraction bridge for the predict REPL.
+"""Extraction bridge for the serving layer.
 
-Reference parity target: `extractor.py` (SURVEY.md §2 L5, §3): subprocess
-the extractor on one file, parse stdout into (method_name, context_lines),
+Reference parity target: `extractor.py` (SURVEY.md §2 L5, §3): run the
+extractor on one file, parse stdout into (method_name, context_lines),
 raise on failure. The reference shells out to the JavaExtractor jar; we
-shell out to the native C++ extractor (code2vec_tpu/extractor/, built by
-build_extractor.sh) whose stdout format is identical (SURVEY.md §3.2).
+prefer the in-process ctypes bindings to the native C++ extractor
+(extractor/native.py, libc2v.so — no subprocess spawn per request) and
+fall back to the c2v_extract CLI, both built by build_extractor.sh with
+identical output (SURVEY.md §3.2).
+
+`ExtractorPool` is the serving server's persistent worker pool: N
+threads sharing one `Extractor`, validated up front (`preflight()`), so
+a missing or non-executable binary fails at server start with the
+build_extractor.sh hint instead of as an opaque subprocess error on the
+first request.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
 import shutil
 import subprocess
@@ -20,6 +29,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _DEFAULT_BINARY = os.path.join(_REPO_ROOT, "code2vec_tpu", "extractor",
                                "build", "c2v_extract")
+_BUILD_HINT = ("build it with ./build_extractor.sh "
+               "(see code2vec_tpu/extractor/)")
 
 
 class ExtractorError(RuntimeError):
@@ -29,24 +40,52 @@ class ExtractorError(RuntimeError):
 class Extractor:
     def __init__(self, config: Config, extractor_path: str = None,
                  max_path_length: int = 8, max_path_width: int = 2,
-                 language: str = "java"):
+                 language: str = "java", use_native: bool = True):
         self.config = config
         self.max_path_length = max_path_length
         self.max_path_width = max_path_width
         self.language = language
+        # in-process libc2v (thread-safe: the C API is stateless) —
+        # skips the per-request subprocess spawn when the lib is built
+        self.use_native = use_native
         self.extractor_path = (extractor_path
                                or os.environ.get("C2V_EXTRACTOR")
                                or _DEFAULT_BINARY)
 
+    def _native_lib(self):
+        if not self.use_native or self.language != "java":
+            return None
+        from code2vec_tpu.extractor import native
+        return native._load()
+
     def _binary(self) -> str:
         if os.path.exists(self.extractor_path):
+            if not os.access(self.extractor_path, os.X_OK):
+                raise ExtractorError(
+                    f"native extractor at {self.extractor_path} is not "
+                    f"executable (incomplete build?); re-{_BUILD_HINT}")
             return self.extractor_path
         found = shutil.which("c2v_extract")
         if found:
             return found
         raise ExtractorError(
-            f"native extractor not found at {self.extractor_path}; build "
-            f"it with ./build_extractor.sh (see code2vec_tpu/extractor/)")
+            f"native extractor not found at {self.extractor_path}; "
+            f"{_BUILD_HINT}")
+
+    def preflight(self) -> None:
+        """Validate the extraction backend up front (server start /
+        pool construction) so misconfiguration raises `ExtractorError`
+        with the build hint, not an opaque error mid-request."""
+        if self.language == "python":
+            try:
+                import code2vec_tpu.extractor.python_extractor  # noqa: F401
+            except ImportError as e:
+                raise ExtractorError(
+                    f"python extractor unavailable: {e}") from e
+            return
+        if self._native_lib() is not None:
+            return
+        self._binary()
 
     def extract_paths(self, path: str) -> Tuple[List[str], List[str]]:
         """Returns (method_names, raw_context_lines) for one source file;
@@ -61,6 +100,17 @@ class Extractor:
                     f"python extractor unavailable: {e}") from e
             lines = extract_file(path, self.max_path_length,
                                  self.max_path_width)
+        elif self._native_lib() is not None:
+            # in-process extraction: no subprocess spawn per request
+            from code2vec_tpu.extractor import native
+            try:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    source = f.read()
+            except OSError as e:
+                raise ExtractorError(f"cannot read {path}: {e}") from e
+            lines = native.extract_source(source, self.max_path_length,
+                                          self.max_path_width)
         else:
             cmd = [self._binary(), "--file", path,
                    "--max_path_length", str(self.max_path_length),
@@ -71,6 +121,12 @@ class Extractor:
             except subprocess.TimeoutExpired as e:
                 raise ExtractorError(
                     f"extractor timed out on {path}") from e
+            except OSError as e:
+                # exec failure (wrong arch, truncated binary, perms
+                # dropped after the preflight) — keep the hint attached
+                raise ExtractorError(
+                    f"cannot run extractor {cmd[0]}: {e}; "
+                    f"re-{_BUILD_HINT}") from e
             if proc.returncode != 0:
                 raise ExtractorError(
                     f"extractor failed ({proc.returncode}): {proc.stderr}")
@@ -79,3 +135,32 @@ class Extractor:
             raise ExtractorError(f"no methods extracted from {path}")
         names = [ln.split(" ", 1)[0] for ln in lines]
         return names, lines
+
+
+class ExtractorPool:
+    """Persistent extraction workers for the prediction server: N
+    threads over ONE `Extractor` (stateless per call), preflighted at
+    construction. Extraction requests stop paying a pool/interpreter
+    spawn per request; with libc2v built they are fully in-process."""
+
+    def __init__(self, config: Config, workers: int = None,
+                 **extractor_kwargs):
+        self.extractor = Extractor(config, **extractor_kwargs)
+        self.extractor.preflight()
+        n = workers if workers is not None \
+            else max(1, config.SERVE_EXTRACT_WORKERS)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="extract")
+
+    def submit(self, path: str) -> "concurrent.futures.Future":
+        """Async extraction; the future resolves to
+        (method_names, raw_context_lines) or raises `ExtractorError`."""
+        return self._pool.submit(self.extractor.extract_paths, path)
+
+    def extract_paths(self, path: str) -> Tuple[List[str], List[str]]:
+        """Synchronous extraction through the pool (keeps concurrent
+        callers bounded by the worker count)."""
+        return self.submit(path).result()
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

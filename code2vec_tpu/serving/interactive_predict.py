@@ -1,18 +1,26 @@
 """Interactive prediction REPL.
 
 Reference parity target: `interactive_predict.py` (SURVEY.md §3, §4.4):
-"Modify Input.java, press Enter" -> extract path-contexts -> model.predict
+"Modify Input.java, press Enter" -> extract path-contexts -> predict
 -> print top-k names with probabilities, attention-ranked path-contexts,
 and optionally the code vector.
+
+Since ISSUE 3 this is a thin client of `serving/server.py`: extraction
+rides the persistent worker pool, prediction goes through the
+micro-batcher (a single-user REPL flushes as a batch of one — output
+identical to the direct path), and repeated extractions of an unchanged
+file hit the LRU prediction cache.
 """
 
 from __future__ import annotations
 
 import os
+import time
 
 from code2vec_tpu.config import Config
 from code2vec_tpu.obs import Telemetry, format_latency_line
-from code2vec_tpu.serving.extractor import Extractor, ExtractorError
+from code2vec_tpu.serving.extractor import ExtractorError
+from code2vec_tpu.serving.server import PredictionServer, ServerOverloaded
 
 SHOW_TOP_CONTEXTS = 10
 DEFAULT_INPUT_FILE = "Input.java"
@@ -23,7 +31,6 @@ class InteractivePredictor:
     def __init__(self, config: Config, model):
         self.config = config
         self.model = model
-        self.extractor = Extractor(config)
         # Serving latency histograms (code2vec_tpu/obs/): per-request
         # extract/encode/predict timers are ALWAYS live (per-request
         # cost is trivial; the p50/p95/p99 line is the product surface),
@@ -37,58 +44,76 @@ class InteractivePredictor:
         if not tele.enabled:
             tele = Telemetry.memory("serve")
         self.telemetry = tele
-        # model.predict() records its serve/encode_ms and
-        # serve/predict_ms spans into the same registry
-        model.telemetry = tele
+        # the server wires model.telemetry to the same registry and owns
+        # the batcher/cache/extractor-pool lifecycle
+        self.server = PredictionServer(config, model, telemetry=tele)
 
     def predict(self, input_file: str = DEFAULT_INPUT_FILE) -> None:
         print(f"Serving. Modify the file: \"{input_file}\", then press any "
               f"key when ready, or \"q\" / \"quit\" / \"exit\" to exit. "
               f"Type \"attack\" (or \"attack <targetName>\") to search "
               f"an adversarial rename for the current file.")
-        while True:
-            user_input = input()
-            if user_input.strip().lower() in EXIT_KEYWORDS:
-                print("Exiting...")
-                self.telemetry.close()  # flush the serve run's summary
-                return
-            if not os.path.exists(input_file):
-                print(f"File not found: {input_file}")
-                continue
-            words = user_input.strip().split()
-            if words and words[0].lower() == "attack":
-                self._attack(input_file,
-                             words[1] if len(words) > 1 else None)
-                continue
-            request_span = self.telemetry.span("serve/request_ms")
-            extract_span = self.telemetry.span("serve/extract_ms")
-            try:
-                _, lines = self.extractor.extract_paths(input_file)
-            except ExtractorError as e:
-                print(f"Extraction error: {e}")
-                continue
-            extract_ms = extract_span.stop()
-            results = self.model.predict(lines)
-            request_ms = request_span.stop()
-            self.telemetry.count("serve/requests")
-            self.telemetry.event(
-                "request", request_ms=round(request_ms, 3),
-                extract_ms=round(extract_ms, 3),
-                n_methods=len(results))
-            for res in results:
-                print(f"Original name:\t{res.original_name}")
-                for pred in res.predictions:
-                    print(f"\t({pred['probability']:.6f}) "
-                          f"predicted: {pred['name']}")
-                print("Attention:")
-                for ap in res.attention_paths[:SHOW_TOP_CONTEXTS]:
-                    print(f"{ap.attention_score:.6f}\tcontext: "
-                          f"{ap.source_token},{ap.path},{ap.target_token}")
-                if res.code_vector is not None:
-                    print("Code vector:")
-                    print(" ".join(f"{x:.5f}" for x in res.code_vector))
-            print(format_latency_line(
-                self.telemetry.timer("serve/request_ms"), request_ms))
+        # warmup=False: a single-user REPL compiles predict buckets as
+        # it meets them (the pre-server behavior) instead of paying all
+        # --serve_batch_max bucket compiles on the first keystroke;
+        # warmed-bucket serving is the load path (tools/loadgen.py).
+        self.server.start(warmup=False)
+        # try/finally: Ctrl-C or piped-stdin EOF must still flush the
+        # serve run's JSONL summary instead of crashing the REPL with an
+        # uncaught EOFError and an unflushed event log.
+        try:
+            while True:
+                try:
+                    user_input = input()
+                except (EOFError, KeyboardInterrupt):
+                    # EOF (piped stdin exhausted) and Ctrl-C are exits,
+                    # not errors
+                    print("Exiting...")
+                    return
+                if user_input.strip().lower() in EXIT_KEYWORDS:
+                    print("Exiting...")
+                    return
+                if not os.path.exists(input_file):
+                    print(f"File not found: {input_file}")
+                    continue
+                words = user_input.strip().split()
+                if words and words[0].lower() == "attack":
+                    self._attack(input_file,
+                                 words[1] if len(words) > 1 else None)
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    # deadline_ms=0: a single user is never "overload" —
+                    # the first request may sit out a cold jit compile
+                    # (tens of seconds on TPU) and must still succeed
+                    results = self.server.predict_file(input_file,
+                                                       deadline_ms=0)
+                except ExtractorError as e:
+                    print(f"Extraction error: {e}")
+                    continue
+                except ServerOverloaded as e:
+                    print(f"Server overloaded: {e}")
+                    continue
+                request_ms = (time.perf_counter() - t0) * 1e3
+                for res in results:
+                    print(f"Original name:\t{res.original_name}")
+                    for pred in res.predictions:
+                        print(f"\t({pred['probability']:.6f}) "
+                              f"predicted: {pred['name']}")
+                    print("Attention:")
+                    for ap in res.attention_paths[:SHOW_TOP_CONTEXTS]:
+                        print(f"{ap.attention_score:.6f}\tcontext: "
+                              f"{ap.source_token},{ap.path},"
+                              f"{ap.target_token}")
+                    if res.code_vector is not None:
+                        print("Code vector:")
+                        print(" ".join(f"{x:.5f}"
+                                       for x in res.code_vector))
+                print(format_latency_line(
+                    self.telemetry.timer("serve/request_ms"), request_ms))
+        finally:
+            self.server.close()
+            self.telemetry.close()  # flush the serve run's summary
 
     def _attack(self, input_file: str, target: str) -> None:
         """REPL `attack [targetName]` command: run the gradient rename

@@ -1,0 +1,319 @@
+"""Batched prediction server (ISSUE 3 tentpole).
+
+Turns the per-request predict path (extract -> parse -> batch-1 device
+call) into a throughput engine:
+
+  - client threads call `predict_lines()` / `predict_file()`; parsing
+    (`model.prepare_predict_rows`) runs on the CALLER's thread, so host
+    work scales with clients while the device stays single-owner;
+  - a `MicroBatcher` (serving/batcher.py) coalesces concurrent requests
+    into one padded device batch at the power-of-two buckets the jitted
+    predict step compiles — `start()` warms every bucket up to
+    `--serve_batch_max`, so steady-state serving triggers ZERO new jit
+    compilations;
+  - an LRU prediction cache keyed by the normalized path-context bag:
+    hits skip encode + device entirely (`serve/cache_hit` counter);
+  - admission control: a bounded queue plus per-request deadline shed
+    load with an explicit `ServerOverloaded` instead of unbounded
+    latency growth (`serve/shed` counter);
+  - extraction goes through a persistent `ExtractorPool` — no
+    subprocess/pool spawn per request.
+
+Telemetry (code2vec_tpu/obs): `serve/request_ms` / `serve/extract_ms`
+histograms on the request path, `serve/parse_ms` / `serve/encode_ms` /
+`serve/predict_ms` from the model, `serve/queue_depth` and
+`serve/batch_occupancy` gauges, `serve/batch_methods` batch-size
+histogram, and `serve/requests`, `serve/batches`, `serve/cache_hit`,
+`serve/cache_miss`, `serve/shed` counters. The registry is made
+thread-safe (`make_threadsafe`) because client threads, the extractor
+pool, and the batcher all record into it.
+
+Cache semantics: a method whose contexts exceed MAX_CONTEXTS is
+downsampled at parse time by a draw seeded from the SAME normalized
+bag the cache key uses (data/reader.parse_c2v_rows), so a cached
+prediction equals what a fresh parse of that bag would produce —
+regardless of where in a batch, or in what context order, the method
+reappears.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from code2vec_tpu.common import MethodPredictionResults
+from code2vec_tpu.config import Config
+from code2vec_tpu.obs import Telemetry
+from code2vec_tpu.serving.batcher import (MicroBatcher, PredictRequest,
+                                          ServerOverloaded)
+from code2vec_tpu.serving.extractor import ExtractorPool
+
+__all__ = ["PredictionServer", "PredictionCache", "ServerOverloaded",
+           "normalize_bag"]
+
+
+def normalize_bag(line: str) -> Tuple[str, Tuple[str, ...]]:
+    """Cache key for one extractor line: (method name, sorted bag of
+    non-empty context fields). Context ORDER is irrelevant to the model
+    (a bag-of-contexts / set encoder), so reordered extractions of the
+    same method hit the same entry; padding fields ('' / ',,') are
+    dropped the same way the parser drops them."""
+    # rstrip exactly like parse_c2v_rows: a newline-terminated copy of
+    # a line must hit the same cache entry as the bare one
+    parts = line.rstrip("\n").split(" ")
+    ctxs = sorted(p for p in parts[1:] if p and p != ",,")
+    return parts[0], tuple(ctxs)
+
+
+class PredictionCache:
+    """Thread-safe LRU over normalized path-context bags. Values are the
+    finished `MethodPredictionResults` — a hit skips parse, encode and
+    the device round-trip entirely."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+
+    def get(self, key) -> Optional[MethodPredictionResults]:
+        if self.capacity <= 0:
+            return None
+        with self._lock:
+            val = self._d.get(key)
+            if val is not None:
+                self._d.move_to_end(key)
+            return val
+
+    def put(self, key, value: MethodPredictionResults) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+class PredictionServer:
+    """The serving facade: request queue + micro-batcher + cache +
+    extractor pool around one model. `InteractivePredictor` is a thin
+    client of this; `tools/loadgen.py` drives it at target QPS."""
+
+    def __init__(self, config: Config, model, telemetry: Telemetry = None):
+        self.config = config
+        self.model = model
+        tele = telemetry if telemetry is not None \
+            else Telemetry.memory("serve")
+        tele.make_threadsafe()
+        self.telemetry = tele
+        # the model's serve/encode_ms + serve/predict_ms spans land in
+        # the same registry (as the REPL always arranged)
+        model.telemetry = tele
+        self.cache = PredictionCache(config.SERVE_CACHE_SIZE)
+        self.batcher = MicroBatcher(
+            self._run_batch, max_batch=config.SERVE_BATCH_MAX,
+            timeout_ms=config.SERVE_BATCH_TIMEOUT_MS,
+            queue_depth=config.SERVE_QUEUE_DEPTH, telemetry=tele)
+        self._extractors: Optional[ExtractorPool] = None
+        self._extractor_kwargs: Optional[Dict] = None
+        self._started = False
+        self._lifecycle_lock = threading.Lock()
+
+    # ---- lifecycle ----
+    def start(self, warmup: bool = True) -> "PredictionServer":
+        """Warm the predict-step shape buckets (compile once, serve
+        forever) and start the batcher thread. Idempotent — safe under
+        concurrent first requests (predict_lines auto-starts)."""
+        with self._lifecycle_lock:
+            if self._started:
+                return self
+            if warmup:
+                t0 = time.perf_counter()
+                buckets = self.model.warmup_predict(
+                    self.config.SERVE_BATCH_MAX)
+                self.telemetry.event(
+                    "serve_warmup", buckets=buckets,
+                    warmup_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                    compiled=self.model.predict_compile_count())
+            self.batcher.start()
+            self._started = True
+        return self
+
+    def close(self) -> None:
+        with self._lifecycle_lock:
+            self.batcher.stop()
+            if self._extractors is not None:
+                self._extractors.close()
+                self._extractors = None
+                self._extractor_kwargs = None
+            self._started = False
+
+    def extractor_pool(self, **extractor_kwargs) -> ExtractorPool:
+        """The persistent extraction pool, built (and preflighted) once
+        on first use so line-only serving never requires the binary.
+        The first call fixes the extractor configuration — a later call
+        with different kwargs is an error (swapping would close a pool
+        other threads are extracting on)."""
+        with self._lifecycle_lock:
+            if self._extractors is None:
+                self._extractors = ExtractorPool(self.config,
+                                                 **extractor_kwargs)
+                self._extractor_kwargs = dict(extractor_kwargs)
+            elif extractor_kwargs != self._extractor_kwargs:
+                raise ValueError(
+                    f"extractor pool already built with "
+                    f"{self._extractor_kwargs}; restart the server to "
+                    f"change extractor settings (got {extractor_kwargs})")
+            return self._extractors
+
+    # ---- request path (client threads) ----
+    def predict_file(self, path: str, deadline_ms: float = None,
+                     **extractor_kwargs) -> List[MethodPredictionResults]:
+        """Extract one source file through the worker pool, then predict
+        its methods through the batcher. `serve/request_ms` covers
+        extract + predict end-to-end, exactly as the pre-server REPL
+        recorded it."""
+        request_span = self.telemetry.span("serve/request_ms")
+        span = self.telemetry.span("serve/extract_ms")
+        _, lines = self.extractor_pool(**extractor_kwargs) \
+            .extract_paths(path)
+        extract_ms = span.stop()
+        return self.predict_lines(lines, deadline_ms=deadline_ms,
+                                  extract_ms=extract_ms,
+                                  _request_span=request_span)
+
+    def predict_lines(self, lines: Sequence[str],
+                      deadline_ms: float = None,
+                      extract_ms: float = None,
+                      _request_span=None
+                      ) -> List[MethodPredictionResults]:
+        """Predict a bag of extractor lines (one result per non-empty
+        line, input order). Raises `ServerOverloaded` when shed by
+        admission control or past its deadline. `deadline_ms=0`
+        explicitly disables the deadline (a single-user client waiting
+        out a cold jit compile); None takes `--serve_deadline_ms`."""
+        if not self._started:
+            self.start()
+        request_span = (_request_span if _request_span is not None
+                        else self.telemetry.span("serve/request_ms"))
+        lines = [ln for ln in lines if ln.strip()]
+        if not lines:
+            return []
+        if deadline_ms is None:
+            deadline_ms = self.config.SERVE_DEADLINE_MS
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms and deadline_ms > 0 else None)
+
+        # cache probe: hits never touch the queue (skipped entirely at
+        # capacity 0 — no key sorts, no counters, on the load path)
+        out: List[Optional[MethodPredictionResults]] = [None] * len(lines)
+        use_cache = self.cache.capacity > 0
+        keys: List = [None] * len(lines)
+        miss_idx: List[int] = []
+        if use_cache:
+            for i, ln in enumerate(lines):
+                keys[i] = key = normalize_bag(ln)
+                hit = self.cache.get(key)
+                if hit is not None:
+                    out[i] = hit
+                    self.telemetry.count("serve/cache_hit")
+                else:
+                    miss_idx.append(i)
+                    self.telemetry.count("serve/cache_miss")
+        else:
+            miss_idx = list(range(len(lines)))
+
+        if miss_idx:
+            # host parse on the CALLER's thread — the batcher only sees
+            # ready-to-pad rows; oversized requests chunk to max_batch
+            # so every flush stays inside the warmed buckets
+            prepared = self.model.prepare_predict_rows(
+                [lines[i] for i in miss_idx])
+            cap = self.batcher.max_batch
+            chunks = [prepared.slice(at, min(at + cap, prepared.n))
+                      for at in range(0, prepared.n, cap)]
+            reqs = []
+            for chunk in chunks:
+                req = PredictRequest(chunk, chunk.n, deadline=deadline)
+                if not self.batcher.submit(req):
+                    # shed the WHOLE request: resolve the sibling
+                    # chunks already queued so the batcher skips them
+                    # instead of computing results nobody will consume.
+                    # serve/shed counts CHUNKS (queue units) on every
+                    # shed path; loadgen's `shed` counts requests.
+                    overload = ServerOverloaded(
+                        "server shutting down"
+                        if not self.batcher.running else
+                        f"request queue full "
+                        f"(depth {self.batcher.queue_depth})")
+                    n_shed = 1  # the refused chunk
+                    for prev in reqs:
+                        if prev.fail(overload):
+                            n_shed += 1
+                    self.telemetry.count("serve/shed", n_shed)
+                    raise overload
+                reqs.append(req)
+            miss_results: List[MethodPredictionResults] = []
+            try:
+                for chunk, req in zip(chunks, reqs):
+                    # wait past the deadline by one batch window so an
+                    # in-flight batch containing this request can still
+                    # land
+                    wait_s = None
+                    if deadline is not None:
+                        wait_s = max(0.0, deadline - time.monotonic()) \
+                            + self.batcher.timeout_s + 5.0
+                    if not req.wait(wait_s):
+                        if req.fail(ServerOverloaded(
+                                "request timed out")):
+                            # our fail won (vs a late batch result)
+                            self.telemetry.count("serve/shed")
+                    if req.error is not None:
+                        raise req.error
+                    # decode on the CALLER's thread: the batcher's
+                    # critical path stays device-only, decode
+                    # parallelizes across clients
+                    miss_results.extend(self.model.decode_predictions(
+                        chunk, req.result))
+            except BaseException:
+                # resolve any still-pending sibling chunks so the
+                # batcher skips them (no device work for a dead waiter)
+                dead = ServerOverloaded("sibling chunk failed")
+                for r in reqs:
+                    r.fail(dead)
+                raise
+            for i, res in zip(miss_idx, miss_results):
+                out[i] = res
+                if use_cache:
+                    self.cache.put(keys[i], res)
+
+        self.telemetry.count("serve/requests")
+        request_ms = request_span.stop()
+        fields = {"request_ms": round(request_ms, 3),
+                  "n_methods": len(lines),
+                  "n_cached": len(lines) - len(miss_idx)}
+        if extract_ms is not None:  # keep the PR-2 request-event shape
+            fields["extract_ms"] = round(extract_ms, 3)
+        self.telemetry.event("request", **fields)
+        return out  # fully populated: every index was a hit or a miss
+
+    # ---- batch execution (batcher thread) ----
+    def _run_batch(self, requests: Sequence[PredictRequest]) -> List:
+        """One coalesced device call; each request gets back the row
+        slice of the device output matching its own rows (numpy views —
+        no copy). Decode happens on the waiting client's thread."""
+        from code2vec_tpu.models.jax_model import PreparedRows
+        prepared = PreparedRows.concat([r.rows for r in requests])
+        out = self.model.predict_device(prepared)
+        split = []
+        at = 0
+        for r in requests:
+            split.append(tuple(a[at:at + r.n] for a in out))
+            at += r.n
+        return split

@@ -1,0 +1,101 @@
+"""tools/loadgen.py: quick closed/open-loop smoke stays tier-1; the
+long-run (`--duration`) mode is `slow`-marked with the same
+marker-registration guard pattern as test_requant_sweep.py, so tier-1
+(`-m 'not slow'`) can never silently pay for it."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from code2vec_tpu.models.jax_model import Code2VecModel
+from code2vec_tpu.serving.server import PredictionServer
+from tests.helpers import build_tiny_dataset, make_raw_lines
+from tests.test_model import tiny_config
+
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "loadgen",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slow_marker_registered(request):
+    """Tier-1 deselects with -m 'not slow'; that only reliably matches
+    a REGISTERED marker (pytest.ini)."""
+    markers = request.config.getini("markers")
+    assert any(str(m).startswith("slow:") for m in markers), markers
+
+
+def test_gen_corpus_shape_and_distinct():
+    lg = _load_loadgen()
+    corpus = lg.gen_corpus(8, methods_per_request=2, seed=3)
+    assert len(corpus) == 8 and all(len(r) == 2 for r in corpus)
+    # distinct salting: no two methods share a normalized bag, so an
+    # LRU cache cannot turn a load test into a cache benchmark
+    from code2vec_tpu.serving.server import normalize_bag
+    bags = [normalize_bag(ln) for req in corpus for ln in req]
+    assert len(set(bags)) == len(bags)
+
+
+@pytest.fixture(scope="module")
+def loadgen_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("lg_ds")
+    prefix = build_tiny_dataset(str(d), n_train=64, n_val=8, n_test=8,
+                                max_contexts=16)
+    cfg = tiny_config(prefix)
+    return cfg, Code2VecModel(cfg)
+
+
+def test_open_loop_reports_offered_qps(loadgen_model):
+    lg = _load_loadgen()
+    cfg, model = loadgen_model
+    server = PredictionServer(cfg, model)
+    server.start()
+    try:
+        corpus = [make_raw_lines(1, seed=i) for i in range(8)]
+        rep = lg.run_load(server, corpus, mode="open", concurrency=4,
+                          qps=200.0)
+        assert rep["mode"] == "open" and rep["offered_qps"] == 200.0
+        assert rep["ok"] + rep["shed"] + rep["errors"] == 8
+        assert rep["errors"] == 0
+        assert rep["latency"]["count"] == rep["ok"]
+    finally:
+        server.close()
+
+
+@pytest.mark.slow
+def test_loadgen_long_run_cli(tmp_path, capsys):
+    """Long-run CLI mode: --duration loops the corpus; compare mode
+    reports the sequential-vs-batched speedup and the telemetry run
+    renders a serving row."""
+    lg = _load_loadgen()
+    tdir = str(tmp_path / "tele")
+    out = str(tmp_path / "report.json")
+    rc = lg.main(["--mode", "compare", "--synthetic", "--requests", "32",
+                  "--concurrency", "8", "--duration", "3",
+                  "--telemetry_dir", tdir, "--out", out])
+    assert rc == 0
+    with open(out, encoding="utf-8") as f:
+        report = json.load(f)
+    assert len(report["reports"]) == 2
+    assert "speedup" in report
+    bat = report["reports"][1]
+    assert bat["new_compilations_under_load"] in (0, None) or \
+        bat["new_compilations_under_load"] <= 0
+    # the telemetry run carries the loadgen events -> serving row
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "telemetry_report.py"))
+    trep = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(trep)
+    rendered = trep.render(trep.find_runs(tdir))
+    assert "Serving mode" in rendered
+    capsys.readouterr()  # swallow loadgen's stdout JSON

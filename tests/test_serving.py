@@ -1,12 +1,18 @@
 """Interactive predict REPL (SURVEY.md §4.4): scripted session over a
 real Java file through the native extractor — prints top-k predictions
-and attention-ranked contexts, exits on 'q'."""
+and attention-ranked contexts, exits on 'q'. Plus the ISSUE 3 REPL
+satellites: EOF/Ctrl-C exit cleanly with a flushed telemetry summary,
+and a missing/non-executable extractor binary fails up front with the
+build_extractor.sh hint."""
 
+import json
 import os
 
 import pytest
 
 from code2vec_tpu.models.jax_model import Code2VecModel
+from code2vec_tpu.serving.extractor import (Extractor, ExtractorError,
+                                            ExtractorPool)
 from code2vec_tpu.serving.interactive_predict import InteractivePredictor
 from tests.helpers import build_tiny_dataset
 from tests.test_model import tiny_config
@@ -58,3 +64,84 @@ def test_repl_scripted_session(tmp_path, monkeypatch, capsys):
     # attack error — never a traceback)
     assert "untargeted" in out or "Attack error:" in out
     assert "Exiting..." in out
+
+
+# ---------------------------------------------------------------------
+# ISSUE 3 satellites (no native binary required)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repl_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("repl_ds")
+    prefix = build_tiny_dataset(str(d), n_train=64, n_val=8, n_test=8,
+                                max_contexts=16)
+    cfg = tiny_config(prefix)
+    return cfg, Code2VecModel(cfg)
+
+
+def _one_shot_input(exc):
+    calls = {"n": 0}
+
+    def fake_input():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise exc
+        raise AssertionError("REPL kept reading after exit condition")
+    return fake_input
+
+
+@pytest.mark.parametrize("exc", [EOFError, KeyboardInterrupt],
+                         ids=["eof", "ctrl-c"])
+def test_repl_eof_and_interrupt_exit_cleanly(repl_model, tmp_path,
+                                             monkeypatch, capsys, exc):
+    """Piped stdin EOF (and Ctrl-C) must exit the REPL cleanly AND
+    flush the serve run's JSONL summary — before ISSUE 3 the EOFError
+    escaped and `telemetry.close()` never ran."""
+    cfg, model = repl_model
+    cfg.TELEMETRY_DIR = str(tmp_path / "tele")
+    try:
+        monkeypatch.setattr("builtins.input", _one_shot_input(exc()))
+        predictor = InteractivePredictor(cfg, model)
+        predictor.predict(input_file=str(tmp_path / "Input.java"))
+        out = capsys.readouterr().out
+        assert "Exiting..." in out
+        # the serve run's event log got its close()-time summary
+        run_dir = predictor.telemetry.run_dir
+        assert run_dir is not None
+        with open(os.path.join(run_dir, "events.jsonl"),
+                  encoding="utf-8") as f:
+            kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        assert "summary" in kinds
+    finally:
+        cfg.TELEMETRY_DIR = None
+
+
+def test_extractor_missing_binary_hint(tmp_path, monkeypatch):
+    """Regression (satellite): a never-built binary raises ExtractorError
+    with the build_extractor.sh hint up front, not an opaque subprocess
+    error at first request."""
+    from code2vec_tpu.config import Config
+    monkeypatch.setattr("code2vec_tpu.serving.extractor.shutil.which",
+                        lambda _name: None)
+    cfg = Config(SERVE_EXTRACT_WORKERS=1)
+    cfg.train_data_path = "unused"
+    missing = str(tmp_path / "no_such" / "c2v_extract")
+    ex = Extractor(cfg, extractor_path=missing, use_native=False)
+    with pytest.raises(ExtractorError, match="build_extractor.sh"):
+        ex.preflight()
+    # the pool preflights at construction — server start fails early
+    with pytest.raises(ExtractorError, match="build_extractor.sh"):
+        ExtractorPool(cfg, extractor_path=missing, use_native=False)
+
+
+def test_extractor_non_executable_binary_hint(tmp_path):
+    from code2vec_tpu.config import Config
+    cfg = Config()
+    cfg.train_data_path = "unused"
+    fake = tmp_path / "c2v_extract"
+    fake.write_text("not a real binary")
+    fake.chmod(0o644)  # exists but not executable
+    ex = Extractor(cfg, extractor_path=str(fake), use_native=False)
+    with pytest.raises(ExtractorError,
+                       match="not .?executable.*build_extractor.sh"):
+        ex.preflight()

@@ -233,14 +233,48 @@ def test_train_without_flag_is_disabled_and_writes_nothing(tmp_path):
 # ---- serving latency (acceptance: p50/p95/p99 request line) ----
 
 def _scripted_repl(tmp_path, monkeypatch, telemetry_dir=None):
+    import numpy as np
+
+    from code2vec_tpu.models.jax_model import PreparedRows
     from code2vec_tpu.serving.interactive_predict import (
         InteractivePredictor)
 
     class StubModel:
+        """Just enough of the jax_model predict surface for the
+        server's prepare -> device -> decode pipeline."""
         mesh = None
 
-        def predict(self, lines):
+        def prepare_predict_rows(self, lines):
+            n = len([ln for ln in lines if ln.strip()])
+            z = np.zeros((n, 4), np.int32)
+            return PreparedRows(np.zeros((n,), np.int32), z, z, z,
+                                z.astype(np.float32), ["f"] * n,
+                                [[] for _ in range(n)])
+
+        def predict_device(self, prepared):
+            n = prepared.n
+            return (np.zeros((n, 1), np.int32),
+                    np.zeros((n, 1), np.float32),
+                    np.zeros((n, 4), np.float32),
+                    np.zeros((n, 4), np.float32))
+
+        def decode_predictions(self, prepared, device_out):
+            from code2vec_tpu.common import MethodPredictionResults
+            return [MethodPredictionResults(original_name=name)
+                    for name in prepared.target_strings]
+
+        def warmup_predict(self, max_batch):
             return []
+
+        def predict_compile_count(self):
+            return 0
+
+    class StubPool:
+        def extract_paths(self, path):
+            return ("A", ["f a,1,b"])
+
+        def close(self):
+            pass
 
     cfg = Config(MAX_CONTEXTS=16)
     cfg.TELEMETRY_DIR = telemetry_dir
@@ -248,8 +282,8 @@ def _scripted_repl(tmp_path, monkeypatch, telemetry_dir=None):
     with open(input_file, "w") as f:
         f.write("class A { int f() { return 1; } }\n")
     pred = InteractivePredictor(cfg, StubModel())
-    monkeypatch.setattr(pred.extractor, "extract_paths",
-                        lambda path: ("A", ["f a,1,b"]))
+    monkeypatch.setattr(pred.server, "extractor_pool",
+                        lambda **kw: StubPool())
     answers = iter(["", "", "q"])
     monkeypatch.setattr("builtins.input", lambda: next(answers))
     pred.predict(input_file=input_file)

@@ -1,0 +1,336 @@
+#!/usr/bin/env python3
+"""Load generator for the batched serving subsystem (ISSUE 3).
+
+Replays extractor-format requests against `serving/server.py` and
+reports p50/p95/p99 latency + throughput through the obs registry —
+the serving analogue of bench.py's training numbers.
+
+Modes:
+  - closed  — `--concurrency` workers, each issuing its next request the
+              moment the previous one returns (throughput-bound).
+  - open    — requests ARRIVE at `--qps` regardless of completions
+              (Poisson-less fixed-interval arrivals); overload shows up
+              as shed requests, not as a slowed generator.
+  - sequential — the pre-server baseline: one `model.predict` at a time
+              on one thread (what the REPL alone could drive).
+  - compare — sequential then closed on the same corpus; prints the
+              throughput ratio (the ISSUE 3 acceptance metric).
+
+A corpus is one request per line-group: `--corpus <file.c2v>` (raw
+extractor/preprocess lines, grouped `--methods` per request) or the
+built-in synthetic generator. `--load <ckpt>` serves a real model;
+`--synthetic` builds a tiny random-weight model in a temp dir (latency
+is shape-, not value-dependent — fine for load testing).
+
+Long-run mode (`--duration S`) loops the corpus for S seconds — pytest
+runs it `slow`-marked only (tests/test_loadgen.py).
+
+Reports go to stdout as JSON; with `--telemetry_dir` the run also lands
+as a JSONL event log (`kind: loadgen`) that tools/telemetry_report.py
+renders into the BASELINE.md serving row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# mirrors tests/helpers.make_raw_lines' shape but stays standalone:
+# tools must not import the test tree
+_TOKENS = ["foo", "bar", "baz", "qux", "value", "name", "index", "count"]
+_PATHS = [str(h) for h in (123456, -98765, 424242, 1337, -777, 31415)]
+_TARGETS = ["get|value", "set|value", "get|name", "set|name", "add|item",
+            "remove|item", "to|string", "is|empty"]
+
+
+def gen_corpus(n_requests: int, methods_per_request: int = 1,
+               max_ctx: int = 12, seed: int = 0,
+               distinct: bool = True) -> List[List[str]]:
+    """Synthetic extractor-format requests. `distinct=True` salts every
+    method's token choice with its global index so an LRU cache can't
+    turn a throughput run into a cache benchmark."""
+    rng = random.Random(seed)
+    corpus = []
+    for r in range(n_requests):
+        lines = []
+        for m in range(methods_per_request):
+            uid = r * methods_per_request + m
+            t_idx = rng.randrange(len(_TARGETS))
+            ctxs = []
+            for c in range(rng.randint(2, max_ctx)):
+                tok_a = _TOKENS[(t_idx + c) % len(_TOKENS)]
+                tok_b = (f"u{uid}" if distinct and c == 0
+                         else _TOKENS[(t_idx * 3 + c) % len(_TOKENS)])
+                ctxs.append(f"{tok_a},{rng.choice(_PATHS)},{tok_b}")
+            lines.append(_TARGETS[t_idx] + " " + " ".join(ctxs))
+        corpus.append(lines)
+    return corpus
+
+
+def _percentiles(stat) -> Dict[str, float]:
+    s = stat.summary()
+    return {k: s[k] for k in ("count", "mean_ms", "p50_ms", "p95_ms",
+                              "p99_ms", "max_ms")}
+
+
+def run_sequential(model, corpus: List[List[str]],
+                   duration: Optional[float] = None) -> Dict:
+    """Baseline: one request at a time through `model.predict` — the
+    pre-server path (extract cost excluded on both sides)."""
+    from code2vec_tpu.obs import Telemetry
+    tele = Telemetry.memory("loadgen-seq")
+    t_start = time.perf_counter()
+    done = 0
+    i = 0
+    while True:
+        if duration is None:
+            if i >= len(corpus):
+                break
+        elif time.perf_counter() - t_start >= duration:
+            break
+        t0 = time.perf_counter()
+        model.predict(corpus[i % len(corpus)])
+        tele.record_ms("loadgen/request_ms",
+                       (time.perf_counter() - t0) * 1e3)
+        done += 1
+        i += 1
+    wall = time.perf_counter() - t_start
+    return {"mode": "sequential", "requests": done, "ok": done,
+            "shed": 0, "errors": 0, "wall_s": round(wall, 3),
+            "throughput_rps": round(done / max(wall, 1e-9), 2),
+            "latency": _percentiles(tele.timer("loadgen/request_ms"))}
+
+
+def run_load(server, corpus: List[List[str]], mode: str = "closed",
+             concurrency: int = 8, qps: float = 100.0,
+             duration: Optional[float] = None) -> Dict:
+    """Drive `server.predict_lines` with the chosen arrival process.
+    The server must be started (buckets warmed) by the caller."""
+    from code2vec_tpu.serving.batcher import ServerOverloaded
+
+    tele = server.telemetry
+    lock = threading.Lock()
+    state = {"next": 0, "ok": 0, "shed": 0, "errors": 0}
+    t_start = time.perf_counter()
+
+    def _expired() -> bool:
+        return (duration is not None
+                and time.perf_counter() - t_start >= duration)
+
+    def one(i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            server.predict_lines(corpus[i % len(corpus)])
+            with lock:
+                state["ok"] += 1
+            tele.record_ms("loadgen/request_ms",
+                           (time.perf_counter() - t0) * 1e3)
+        except ServerOverloaded:
+            with lock:
+                state["shed"] += 1
+        except Exception as e:  # noqa: BLE001 — counted + sampled,
+            with lock:          # reported, not fatal
+                state["errors"] += 1
+                state.setdefault("first_error", repr(e))
+
+    if mode == "closed":
+        def worker():
+            while True:
+                with lock:
+                    i = state["next"]
+                    if _expired() or (duration is None
+                                      and i >= len(corpus)):
+                        return
+                    state["next"] = i + 1
+                one(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    elif mode == "open":
+        import concurrent.futures
+        interval = 1.0 / max(qps, 1e-9)
+        n = len(corpus) if duration is None else (1 << 30)
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=concurrency) as pool:
+            futures = []
+            for i in range(n):
+                if _expired():
+                    break
+                futures.append(pool.submit(one, i))
+                if len(futures) >= 4096:
+                    # long-run soak mode: reap finished futures so the
+                    # list stays bounded over hours of offered load
+                    futures = [f for f in futures if not f.done()]
+                next_arrival = t_start + (i + 1) * interval
+                sleep = next_arrival - time.perf_counter()
+                if sleep > 0:
+                    time.sleep(sleep)
+            for f in futures:
+                f.result()
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    wall = time.perf_counter() - t_start
+    issued = state["ok"] + state["shed"] + state["errors"]
+    report = {
+        "mode": mode, "concurrency": concurrency,
+        "requests": issued, "ok": state["ok"], "shed": state["shed"],
+        "errors": state["errors"], "wall_s": round(wall, 3),
+        "throughput_rps": round(state["ok"] / max(wall, 1e-9), 2),
+        "latency": _percentiles(tele.timer("loadgen/request_ms")),
+        "counters": dict(tele.counters),
+    }
+    if state["errors"]:
+        report["first_error"] = state["first_error"]
+    if mode == "open":
+        report["offered_qps"] = qps
+    return report
+
+
+def _build_model(args):
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    if args.load:
+        cfg = Config()
+        cfg.load_path = args.load
+    else:  # --synthetic: tiny random-weight model in a temp workdir
+        from code2vec_tpu.data import preprocess as preprocess_mod
+        workdir = tempfile.mkdtemp(prefix="loadgen_")
+        raw = os.path.join(workdir, "raw.txt")
+        flat = [ln for req in gen_corpus(64, 2, seed=7) for ln in req]
+        with open(raw, "w", encoding="utf-8") as f:
+            f.write("\n".join(flat) + "\n")
+        prefix = os.path.join(workdir, "tiny")
+        preprocess_mod.main([
+            "--train_data", raw, "--val_data", raw, "--test_data", raw,
+            "--max_contexts", "16", "--word_vocab_size", "1000",
+            "--path_vocab_size", "1000", "--target_vocab_size", "1000",
+            "--output_name", prefix])
+        cfg = Config(MAX_CONTEXTS=16, MAX_TOKEN_VOCAB_SIZE=1000,
+                     MAX_PATH_VOCAB_SIZE=1000,
+                     MAX_TARGET_VOCAB_SIZE=1000,
+                     DEFAULT_EMBEDDINGS_SIZE=16, USE_BF16=False)
+        cfg.train_data_path = prefix
+    for name in ("serve_batch_max", "serve_batch_timeout_ms",
+                 "serve_queue_depth", "serve_deadline_ms",
+                 "serve_cache_size"):
+        val = getattr(args, name)
+        if val is not None:
+            setattr(cfg, name.upper(), val)
+    return cfg, Code2VecModel(cfg)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="compare",
+                    choices=["closed", "open", "sequential", "compare"])
+    ap.add_argument("--load", default=None,
+                    help="checkpoint dir; omit for --synthetic")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="tiny random-weight model (default when no "
+                         "--load)")
+    ap.add_argument("--corpus", default=None,
+                    help="file of raw extractor lines; default: "
+                         "synthetic corpus")
+    ap.add_argument("--requests", type=int, default=128)
+    ap.add_argument("--methods", type=int, default=1,
+                    help="methods per request")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="open-loop offered load")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="long-run mode: loop the corpus for S seconds")
+    ap.add_argument("--serve_batch_max", type=int, default=None)
+    ap.add_argument("--serve_batch_timeout_ms", type=float, default=None)
+    ap.add_argument("--serve_queue_depth", type=int, default=None)
+    ap.add_argument("--serve_deadline_ms", type=float, default=None)
+    ap.add_argument("--serve_cache_size", type=int, default=0,
+                    help="0 (default) keeps throughput numbers honest "
+                         "on a repeating corpus")
+    ap.add_argument("--telemetry_dir", default=None)
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+    if args.load and args.synthetic:
+        ap.error("--load and --synthetic are mutually exclusive")
+
+    cfg, model = _build_model(args)
+    if args.telemetry_dir:
+        cfg.TELEMETRY_DIR = args.telemetry_dir
+
+    if args.corpus:
+        with open(args.corpus, encoding="utf-8") as f:
+            flat = [ln for ln in f if ln.strip()]
+        corpus = [flat[i:i + args.methods]
+                  for i in range(0, len(flat), args.methods)]
+        if args.requests and len(corpus) > args.requests:
+            corpus = corpus[:args.requests]
+    else:
+        corpus = gen_corpus(args.requests, args.methods,
+                            max_ctx=min(cfg.MAX_CONTEXTS, 12))
+
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.serving.server import PredictionServer
+    tele = Telemetry.create(cfg.TELEMETRY_DIR, config=cfg,
+                            mesh=getattr(model, "mesh", None),
+                            component="loadgen")
+    if not tele.enabled:
+        tele = Telemetry.memory("loadgen")
+    tele.make_threadsafe()
+
+    reports = []
+    if args.mode in ("sequential", "compare"):
+        model.warmup_predict(args.methods)  # compile the batch-1 bucket
+        reports.append(run_sequential(model, corpus,
+                                      duration=args.duration))
+    if args.mode != "sequential":
+        server = PredictionServer(cfg, model, telemetry=tele)
+        server.start()
+        compiled_after_warmup = model.predict_compile_count()
+        mode = "closed" if args.mode == "compare" else args.mode
+        rep = run_load(server, corpus, mode=mode,
+                       concurrency=args.concurrency, qps=args.qps,
+                       duration=args.duration)
+        if compiled_after_warmup >= 0:
+            rep["compiled_variants_after_warmup"] = compiled_after_warmup
+            rep["new_compilations_under_load"] = (
+                model.predict_compile_count() - compiled_after_warmup)
+        else:
+            # -1 sentinel: the jit cache is not introspectable here —
+            # report unknown, never a false "0 compilations" pass
+            rep["compiled_variants_after_warmup"] = None
+            rep["new_compilations_under_load"] = None
+        server.close()
+        reports.append(rep)
+
+    out = {"reports": reports}
+    if args.mode == "compare" and len(reports) == 2:
+        seq, bat = reports
+        out["speedup"] = round(
+            bat["throughput_rps"] / max(seq["throughput_rps"], 1e-9), 2)
+    for rep in reports:
+        tele.event("loadgen", **rep)
+    tele.close()
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,6 +232,25 @@ def render(run_dirs: List[str]) -> str:
                 f"bench: {_fmt(b.get('value'), 1)} {b.get('metric')} "
                 f"({_fmt(b.get('vs_baseline'), 3)}x V100, "
                 f"{_fmt(b.get('ms_per_step'))} ms/step)")
+        # ---- serving throughput (tools/loadgen.py runs): the
+        # BASELINE.md serving row shape ----
+        load_events = [e for e in events if e.get("kind") == "loadgen"]
+        if load_events:
+            lines.append("")
+            lines.append("| Serving mode | conc | req | ok | shed "
+                         "| req/s | p50 ms | p99 ms | new compiles |")
+            lines.append("|---|---|---|---|---|---|---|---|---|")
+            for e in load_events:
+                lat = e.get("latency") or {}
+                lines.append(
+                    f"| {e.get('mode', '?')} "
+                    f"| {e.get('concurrency', 1)} "
+                    f"| {e.get('requests', 0)} | {e.get('ok', 0)} "
+                    f"| {e.get('shed', 0)} "
+                    f"| {_fmt(e.get('throughput_rps'))} "
+                    f"| {_fmt(lat.get('p50_ms'))} "
+                    f"| {_fmt(lat.get('p99_ms'))} "
+                    f"| {_fmt(e.get('new_compilations_under_load'))} |")
         lines.append("")
     return "\n".join(lines).rstrip() + "\n"
 
