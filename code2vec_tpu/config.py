@@ -19,6 +19,28 @@ import sys
 from typing import Optional
 
 
+# Config attrs with NO CLI flag by design: capacity/architecture
+# constants (reference parity values a flag would invite mis-tuning
+# of) and loop bookkeeping. graftlint's config-drift rule enforces
+# that every OTHER UPPERCASE attr is assigned from a --flag in
+# load_from_args — adding a new attr forces a conscious choice: wire
+# a flag (and document it in README.md) or register it here.
+CONFIG_CONSTANTS = frozenset({
+    "MAX_TOKEN_VOCAB_SIZE",      # reference java-large capacities
+    "MAX_TARGET_VOCAB_SIZE",
+    "MAX_PATH_VOCAB_SIZE",
+    "DEFAULT_EMBEDDINGS_SIZE",   # model dims are checkpoint-manifest-
+    "TARGET_EMBEDDINGS_SIZE",    #   owned, not flag-owned
+    "DROPOUT_KEEP_RATE",
+    "TEST_BATCH_SIZE",
+    "SAVE_EVERY_EPOCHS",
+    "MAX_TO_KEEP",
+    "NUM_BATCHES_TO_LOG_PROGRESS",
+    "TOP_K_WORDS_CONSIDERED_DURING_PREDICTION",
+    "PROFILE_START_STEP",        # --profile_steps is the user knob
+})
+
+
 @dataclasses.dataclass
 class Config:
     # ---- capacities (reference defaults, SURVEY.md §3 config row) ----
@@ -386,6 +408,22 @@ class Config:
                        type=int, default=None)
         p.add_argument("--tables_dtype", dest="tables_dtype", default=None,
                        choices=["float32", "bfloat16", "int8"])
+        p.add_argument("--no_bf16", dest="no_bf16", action="store_true",
+                       help="compute in float32 on the MXU instead of "
+                            "the bfloat16 default (A/B numerics "
+                            "control; tables_dtype governs storage)")
+        p.add_argument("--no_pallas", dest="no_pallas",
+                       action="store_true",
+                       help="disable the fused Pallas kernels (XLA "
+                            "fallback everywhere; the A/B control for "
+                            "the attention-pool and MHA kernels)")
+        p.add_argument("--sparse_embeddings", dest="sparse_embeddings",
+                       action="store_true",
+                       help="touched-rows-only (lazy) Adam for the "
+                            "vocab tables (requires --tables_dtype "
+                            "float32 --embedding_optimizer adam "
+                            "--lr_schedule constant; measured slower "
+                            "than dense on v5e — see ARCHITECTURE.md)")
         p.add_argument("--embedding_optimizer", dest="embedding_optimizer",
                        default=None, choices=["adam", "adafactor"])
         p.add_argument("--requant_pallas", dest="requant_pallas",
@@ -547,6 +585,12 @@ class Config:
             cfg.MAX_CANDIDATES = ns.max_candidates
         if ns.tables_dtype is not None:
             cfg.TABLES_DTYPE = ns.tables_dtype
+        if ns.no_bf16:
+            cfg.USE_BF16 = False
+        if ns.no_pallas:
+            cfg.USE_PALLAS = False
+        if ns.sparse_embeddings:
+            cfg.SPARSE_EMBEDDING_UPDATES = True
         if ns.embedding_optimizer is not None:
             cfg.EMBEDDING_OPTIMIZER = ns.embedding_optimizer
         if ns.requant_pallas is not None:

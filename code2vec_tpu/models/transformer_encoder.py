@@ -87,6 +87,8 @@ def _mha(x: jax.Array, qkv: jax.Array, out: jax.Array,
         ctx = fused_mha(q, k, v, log_mask)
     else:
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        # hd is the Python-int head dim: trace-time scale math, no
+        # device sync here  # graftlint: disable=host-sync-in-hot-path
         logits = logits / jnp.sqrt(float(hd)) \
             + log_mask[:, None, None, :]
         attn = jax.nn.softmax(logits, axis=-1).astype(x.dtype)

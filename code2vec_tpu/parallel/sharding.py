@@ -120,13 +120,19 @@ def shard_batch(mesh: Mesh, arrays, *, process_local: bool = True,
 
     if jax.process_count() == 1:
         return tuple(jax.device_put(a, sharding_for(a)) for a in arrays)
+    # the np.asarray calls below normalize HOST batches before device
+    # placement (the arrays are never on-device yet) — not the
+    # device->host fetch graftlint's host-sync rule is hunting
     if process_local:
         return tuple(
-            jax.make_array_from_process_local_data(sharding_for(a),
-                                                   np.asarray(a))
+            jax.make_array_from_process_local_data(
+                sharding_for(a),
+                np.asarray(a))  # graftlint: disable=host-sync-in-hot-path
             for a in arrays)
     return tuple(
         jax.make_array_from_callback(
-            np.asarray(a).shape, sharding_for(a),
-            lambda idx, _a=np.asarray(a): _a[idx])
+            np.asarray(a).shape,  # graftlint: disable=host-sync-in-hot-path
+            sharding_for(a),
+            lambda idx, _a=np.asarray(a):  # graftlint: disable=host-sync-in-hot-path
+            _a[idx])
         for a in arrays)

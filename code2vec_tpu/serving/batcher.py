@@ -134,12 +134,17 @@ class MicroBatcher:
             self._running = False
             pending = list(self._q)
             self._q.clear()
+            # detach the thread handle UNDER the lock (graftlint
+            # lock-discipline: start() writes it locked, so stop()
+            # clearing it bare raced a concurrent stop/start pair);
+            # join AFTER release — joining under the lock would
+            # deadlock against a consumer blocked in _cond.wait()
+            thread, self._thread = self._thread, None
             self._cond.notify_all()
         for req in pending:
             req.fail(ServerOverloaded("server shutting down"))
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        if thread is not None:
+            thread.join(timeout=5)
 
     # ---- producer side ----
     def submit(self, req: PredictRequest) -> bool:
@@ -173,12 +178,19 @@ class MicroBatcher:
         return self._running
 
     # ---- consumer side ----
-    def _collect(self) -> List[PredictRequest]:
-        """One flush: first request (blocking) + coalescing window."""
+    def _collect(self, me: threading.Thread) -> List[PredictRequest]:
+        """One flush: first request (blocking) + coalescing window.
+
+        `me` is the consumer's OWN thread object; `self._thread is me`
+        is its generation token. A stop()/start() pair that completes
+        while this consumer sleeps in wait() installs a NEW thread, and
+        the `_running` flag is True again — so exit conditions check
+        the token, not the flag, or the superseded consumer would keep
+        draining alongside its replacement (two-consumer race)."""
         with self._cond:
-            while self._running and not self._q:
+            while self._thread is me and not self._q:
                 self._cond.wait()
-            if not self._running:
+            if self._thread is not me:
                 return []
             batch = [self._q.popleft()]
             n = batch[0].n
@@ -195,7 +207,7 @@ class MicroBatcher:
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
-                if not self._running:
+                if self._thread is not me:
                     break
             # keep the gauge honest on the drain side too — submit-only
             # updates would freeze it at the last high-water mark
@@ -225,16 +237,21 @@ class MicroBatcher:
         return live
 
     def _run(self) -> None:
+        me = threading.current_thread()
         while True:
-            batch = self._collect()
-            if not batch and not self._running:
+            batch = self._collect(me)
+            if not batch and self._thread is not me:
+                # superseded (stop, or stop+start installed a fresh
+                # consumer): any batch already dequeued above is still
+                # OURS to finish — those requests left the queue and no
+                # other consumer can see them
                 return
             batch = self._shed_expired(batch)
             if not batch:
                 continue
             n = sum(r.n for r in batch)
             self._tele.count("serve/batches")
-            self._tele.record_ms("serve/batch_methods", float(n))
+            self._tele.record_ms("serve/batch_methods", n)
             self._tele.gauge("serve/batch_occupancy",
                              round(n / self.max_batch, 4), emit=False)
             try:

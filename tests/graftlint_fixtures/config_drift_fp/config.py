@@ -1,0 +1,39 @@
+"""config-drift FALSE POSITIVES: a fully-reconciled mini config."""
+
+import argparse
+import dataclasses
+
+CONFIG_CONSTANTS = frozenset({
+    "DROPOUT",            # constant by design, registered
+})
+
+
+@dataclasses.dataclass
+class Config:
+    BATCH_SIZE: int = 1024
+    DROPOUT: float = 0.75
+    save_path: str = None    # lowercase CLI-surface fields are exempt
+
+    @classmethod
+    def arguments_parser(cls):
+        p = argparse.ArgumentParser()
+        p.add_argument("--batch_size", dest="batch_size", type=int)
+        # dest derived from the flag spelling (no dest= kwarg)
+        p.add_argument("--save")
+        p.add_argument("-v", "--verbose", dest="verbose_mode", type=int)
+        return p
+
+    @classmethod
+    def load_from_args(cls, args=None):
+        ns = cls.arguments_parser().parse_args(args)
+        cfg = cls()
+        if ns.batch_size is not None:
+            cfg.BATCH_SIZE = ns.batch_size
+        cfg.save_path = ns.save
+        if ns.verbose_mode:
+            cfg.BATCH_SIZE = cfg.BATCH_SIZE  # touch so dest is consumed
+        return cfg
+
+    def verify(self):
+        if self.BATCH_SIZE < 1 or not 0 < self.DROPOUT <= 1:
+            raise ValueError("bad config")

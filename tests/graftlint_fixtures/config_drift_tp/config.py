@@ -1,0 +1,45 @@
+"""config-drift TRUE POSITIVES: one of each drift class."""
+
+import argparse
+import dataclasses
+
+CONFIG_CONSTANTS = frozenset({
+    "REAL_CONSTANT",
+    "WIRED_BUT_LISTED",   # TP: listed AND assigned in load_from_args
+    "GHOST_CONSTANT",     # TP: names no dataclass field
+})
+
+
+@dataclasses.dataclass
+class Config:
+    BATCH_SIZE: int = 1024
+    REAL_CONSTANT: int = 7
+    WIRED_BUT_LISTED: int = 1
+    ORPHAN_ATTR: int = 3          # TP: no flag, not in CONFIG_CONSTANTS
+
+    @classmethod
+    def arguments_parser(cls):
+        p = argparse.ArgumentParser()
+        p.add_argument("--batch_size", dest="batch_size", type=int)
+        p.add_argument("--wired", dest="wired", type=int)
+        p.add_argument("--dead_flag", dest="dead_flag", type=int)  # TP
+        p.add_argument("--undocumented", dest="undocumented")      # TP
+        return p
+
+    @classmethod
+    def load_from_args(cls, args=None):
+        ns = cls.arguments_parser().parse_args(args)
+        cfg = cls()
+        cfg.BATCH_SIZE = ns.batch_size
+        cfg.WIRED_BUT_LISTED = ns.wired
+        if ns.undocumented is not None:
+            cfg.BATCH_SIZE = ns.undocumented
+        if ns.phantom is not None:   # TP: no add_argument for this
+            cfg.BATCH_SIZE = ns.phantom
+        return cfg
+
+    def verify(self):
+        if self.BATCH_SIZE < 1:
+            raise ValueError("batch size")
+        if self.BTACH_SIZE > 1 << 20:   # TP: typo'd attr guard
+            raise ValueError("too big")

@@ -1,0 +1,37 @@
+"""host-sync-in-hot-path FALSE POSITIVES the rule must NOT flag:
+shape math, sanctioned sync helpers, cold-path syncs, suppressions."""
+
+import jax
+import numpy as np
+
+
+def device_sync(tree):
+    # sanctioned by name: the obs explicit-sync helper shape
+    return float(tree[0])
+
+
+class _Span:
+    def stop(self, sync=None):
+        # sanctioned (class, name): span(...).stop(sync=...)
+        if sync is not None:
+            device_sync(sync)
+        return 0.0
+
+
+@jax.jit
+def hot_step(params, batch):
+    b = int(batch.shape[0])            # shape math, not a device sync
+    scale = float(params["w"].shape[1] * 2)   # still shape math
+    n = int(len(batch))                # len() is host bookkeeping
+    k = float(1 << 8)                  # constant math
+    span = _Span()
+    span.stop(sync=params)             # sanctioned helper call
+    suppressed = batch.item()  # graftlint: disable=host-sync-in-hot-path
+    return b + scale + n + k + suppressed
+
+
+def cold_report(results):
+    # NOT reachable from any hot root: a report tool may sync freely
+    arr = np.asarray(results)
+    print("report:", float(arr.sum()), arr.item())
+    return arr

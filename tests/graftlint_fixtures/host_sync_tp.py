@@ -1,0 +1,41 @@
+"""host-sync-in-hot-path TRUE POSITIVES: syncs reachable from hot roots.
+
+Parsed, never imported — the names only need to look real.
+"""
+
+import jax
+import numpy as np
+
+
+def fetch_helper(x):
+    # reached transitively from the jitted root below
+    return np.asarray(x)
+
+
+def deeper(x):
+    return fetch_helper(x).sum()
+
+
+@jax.jit
+def hot_step(params, batch):
+    loss = params["w"] @ batch
+    print("loss is", loss)          # TP: print in a jitted root
+    lf = float(loss)                # TP: float() on a runtime value
+    _ = loss.item()                 # TP: .item()
+    jax.block_until_ready(loss)     # TP: bare block_until_ready
+    deeper(loss)                    # TP lands in fetch_helper (2 hops)
+    return lf
+
+
+class MicroBatcher:
+    def _run(self, batch):
+        # TP: batcher-flush root reached by (class, name) pattern
+        return jax.device_get(batch)
+
+
+for _variant in range(1):
+    @jax.jit
+    def loop_defined_step(x):
+        # TP: a jitted def hiding in a loop body must still be indexed
+        # as a hot root (the indexer descends into For/While/except)
+        return x.item()

@@ -1,0 +1,51 @@
+"""lock-discipline FALSE POSITIVES the rule must NOT flag."""
+
+import threading
+
+
+class PlainStats:
+    """No lock anywhere: a single-threaded accumulator mutating its own
+    fields is not a race (obs.TimerStat's shape — its thread safety is
+    the OWNING registry's lock)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, ms):
+        self.count += 1
+        self.total += ms
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._d = {}
+        self._installed = False
+
+    def _guard(self):
+        return self._lock
+
+    def put(self, k, v):
+        with self._guard():        # lock acquired via a helper CALL
+            self._d[k] = v
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+
+    def install(self):
+        # reassigning the LOCK attribute itself is setup, not a race
+        self._lock = threading.RLock()
+        with self._lock:
+            self._installed = True
+
+    def reader(self):
+        # bare READS are deliberately out of scope (lock-free flag
+        # reads are an idiom: MicroBatcher.running)
+        return len(self._d), self._installed
+
+    def suppressed_reset(self):
+        # single-owner teardown, documented:
+        # graftlint: disable=lock-discipline
+        self._installed = False

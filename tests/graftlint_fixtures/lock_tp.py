@@ -1,0 +1,69 @@
+"""lock-discipline TRUE POSITIVES: attrs mutated locked AND bare."""
+
+import threading
+
+
+class RacyQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []          # construction writes are exempt
+        self._running = False
+
+    def start(self):
+        with self._lock:
+            self._running = True   # locked here...
+
+    def stop(self):
+        self._running = False      # TP: ...bare here
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)  # locked mutator call...
+
+    def drain(self):
+        out = list(self._items)
+        self._items.clear()        # TP: ...bare mutator call
+        return out
+
+
+class RacyCond:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._depth = 0
+
+    def inc(self):
+        with self._cond:
+            self._depth += 1       # locked AugAssign...
+
+    def dec(self):
+        self._depth -= 1           # TP: ...bare AugAssign
+
+
+class RacyClassLock:
+    # the class-attribute lock idiom — still taken as `with self._lock`
+    _lock = threading.Lock()
+
+    def grow(self):
+        with self._lock:
+            self._size = 1          # locked...
+
+    def shrink(self):
+        self._size = 0              # TP: ...bare
+
+
+class RacyUnpack:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self._assembled = False    # 'sem' substring is NOT lock-ish
+
+    def start(self):
+        with self._lock:
+            self._thread, self._assembled = object(), True
+
+    def stop(self):
+        # TP x2: tuple-unpacking mutations outside the lock (the exact
+        # syntax of the batcher-lifecycle fix this rule guards)
+        thread, self._thread = self._thread, None
+        self._assembled = False
+        return thread

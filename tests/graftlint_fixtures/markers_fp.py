@@ -1,0 +1,32 @@
+"""test-marker-hygiene FALSE POSITIVES the rule must NOT flag."""
+
+import time
+
+import pytest
+
+
+@pytest.mark.slow            # registered marker, correctly spelled
+def test_long_soak_marked():
+    time.sleep(5.0)          # fine: the test IS slow-marked
+
+
+@pytest.mark.slow
+def test_duration_cli_marked():
+    return ["--mode", "compare", "--duration", "30"]
+
+
+@pytest.mark.parametrize("n", [1, 2])
+@pytest.mark.skipif(True, reason="builtin marks need no registration")
+def test_builtin_marks(n):
+    pass
+
+
+def test_handoff_sleeps():
+    # sub-second sleeps are thread-handoff timing, not a long run
+    time.sleep(0.05)
+    time.sleep(0.5)
+
+
+def test_dynamic_sleep(request):
+    # non-constant sleep durations are out of static reach — not flagged
+    time.sleep(request.param if hasattr(request, "param") else 0.01)

@@ -1,0 +1,26 @@
+"""test-marker-hygiene TRUE POSITIVES (parsed only, never collected —
+the filename doesn't match pytest's test_*.py pattern)."""
+
+import time
+
+import pytest
+
+
+@pytest.mark.slwo            # TP: typo'd marker — would RUN in tier-1
+def test_requant_sweep_full_grid():
+    pass
+
+
+def test_long_soak():
+    time.sleep(5.0)          # TP: >= 1 s sleep without @pytest.mark.slow
+
+
+def test_duration_cli():
+    # TP: long-run CLI mode without the slow marker
+    return ["--mode", "compare", "--duration", "30"]
+
+
+@pytest.mark.parametrize(
+    "case", [pytest.param(1, marks=pytest.mark.sloow)])  # TP: typo
+def test_param_typo(case):
+    pass

@@ -1,0 +1,50 @@
+"""retrace-hazard FALSE POSITIVES the rule must NOT flag."""
+
+import functools
+
+import jax
+
+
+def make_train_step(dims):
+    # the repo factory idiom: jit ONCE at build time, closure reused —
+    # a def inside a caller's loop is fine, the jit call runs once
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def step(params, batch, flag):
+        return params @ batch if flag else batch
+
+    return step
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def kernel(x, block_rows=128, interpret=False):
+    # literal-tuple statics are exactly what the cache wants
+    return x
+
+
+class Model:
+    def __init__(self, dims):
+        self._predict_step = make_train_step(dims)
+
+    def predict(self, params, batch):
+        # bucketing WITHOUT branching on .shape at the call site: the
+        # sanctioned pow-2 pad pattern (predict_bucket_size)
+        padded = max(1, 1 << (batch.shape[0] - 1).bit_length())
+        return self._predict_step(params, batch, padded > 0)
+
+    def warm(self, params, buckets):
+        for b in buckets:
+            # calling an ALREADY-jitted step in a loop is the warmup
+            # idiom, not a retrace storm
+            self._predict_step(params, b, True)
+
+
+def setup_elsewhere():
+    f = jax.jit(lambda x: x)    # local binding, local scope
+    return f
+
+
+def unrelated_reuse():
+    # the NAME f is plain abs here — a jit binding in another
+    # function's scope must not leak onto this call site
+    f = abs
+    return f(2.0)

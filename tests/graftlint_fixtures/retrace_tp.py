@@ -1,0 +1,38 @@
+"""retrace-hazard TRUE POSITIVES."""
+
+import functools
+
+import jax
+
+_step = jax.jit(lambda p, b: p @ b)
+
+
+def storm(batches):
+    for b in batches:
+        f = jax.jit(lambda p: p @ b)      # TP: jit built per iteration
+        yield f(b)
+
+
+def one_shot(p, b):
+    return jax.jit(lambda x: x + 1)(p)    # TP: jit(f)(args)
+
+
+def bad_statics(fn, axes):
+    g = jax.jit(fn, static_argnums=axes)          # TP: computed statics
+    h = functools.partial(jax.jit,
+                          static_argnames=[1, 2])  # TP: ints for names
+    return g, h
+
+
+def scalar_feed(params):
+    return _step(params, 3.5)             # TP: Python scalar traced arg
+
+
+def dict_feed(params):
+    return _step(params, {"x": params})   # TP: dict literal traced arg
+
+
+def shape_branchy(params, batch):
+    if batch.shape[0] > 128:              # TP: shape-derived branch
+        return _step(params, batch)
+    return _step(params, batch)

@@ -1,0 +1,33 @@
+"""graftlint: repo-aware static analysis for the jax_graft codebase.
+
+ISSUE 4 tentpole: the bug classes pytest cannot see — host syncs that
+only cost performance, jit retrace storms that only fire under load,
+data races that only fire under concurrency, and flag/doc drift that
+only bites users — are exactly the classes prior rounds kept re-fixing
+by hand (ADVICE r5 #1-#3, the batcher lock race, the bag-order
+downsample bug). code2vec itself is static analysis over ASTs; this
+package walks OUR ASTs to keep those classes fixed.
+
+Contract: stdlib-only (`ast` + `tokenize`, never `import jax` /
+`import tensorflow` / any scanned module), so the suite runs in tier-1
+on any platform in well under the 30 s budget. `tests/test_graftlint.py`
+proves the no-JAX/no-TF property with the blocked-module subprocess
+pattern from tests/test_obs_guard.py.
+
+Usage:
+    python -m tools.graftlint [--format json] [--rules r1,r2] [paths]
+Suppression:
+    # graftlint: disable=<rule>[,<rule>...]       (this line / next line)
+    # graftlint: disable-file=<rule>[,<rule>...]  (whole file)
+Baseline:
+    graftlint_baseline.json at the repo root grandfathers pre-existing
+    findings (line-number-insensitive match); `--write-baseline`
+    regenerates it, review the diff before committing.
+"""
+
+from tools.graftlint.core import (DEFAULT_PATHS, Finding, FileContext,
+                                  REPO_ROOT, Rule, all_rules, get_rule,
+                                  run_lint)
+
+__all__ = ["DEFAULT_PATHS", "Finding", "FileContext", "REPO_ROOT",
+           "Rule", "all_rules", "get_rule", "run_lint"]

@@ -1,0 +1,130 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint                       # default scan set
+    python -m tools.graftlint --format json serving
+    python -m tools.graftlint --rules lock-discipline,config-drift
+    python -m tools.graftlint --write-baseline      # regenerate + review
+
+Exit status: 0 = no non-baselined findings, 1 = findings, 2 = usage.
+Stale baseline entries (fixed findings whose entry lingers) are
+reported but do not fail the run — `--write-baseline` drops them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.core import (DEFAULT_PATHS, REPO_ROOT, all_rules,
+                                  iter_py_files, run_lint)
+
+
+def main(argv: List[str] = None) -> int:
+    rules = all_rules()
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-aware static analysis (see README.md "
+                    "'Static analysis')")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help=f"files/dirs to scan (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of: "
+                        + ", ".join(sorted(rules)))
+    p.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                   help="baseline file (default: repo-root "
+                        "graftlint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, grandfathered or not")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from this run's findings "
+                        "(refuses serving/ and obs/ entries) and exit 0")
+    p.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    selected = None
+    if args.rules:
+        selected = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in selected if r not in rules]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(rules))})", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run_lint(args.paths, root=args.root, rules=selected)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if selected is not None or sorted(args.paths) != sorted(
+                DEFAULT_PATHS):
+            # a partial scan would overwrite the baseline with its
+            # subset, silently deleting every entry outside the scope
+            print("--write-baseline requires a full default scan "
+                  "(no --rules, no path arguments) — a partial scan "
+                  "would drop out-of-scope baseline entries",
+                  file=sys.stderr)
+            return 2
+        refused = baseline_mod.write(findings, args.baseline)
+        print(f"baseline: wrote {len(findings) - len(refused)} "
+              f"entr{'y' if len(findings) - len(refused) == 1 else 'ies'}"
+              f" -> {args.baseline}")
+        for f in refused:
+            print(f"REFUSED (fix, don't baseline): {f.render()}")
+        return 1 if refused else 0
+
+    entries = [] if args.no_baseline else baseline_mod.load(args.baseline)
+    # On a SCOPED scan (path or rule subset), out-of-scope baseline
+    # entries are simply not re-checked — comparing them against
+    # partial findings would misreport every one as stale ("fixed").
+    # In scope = the entry's rule ran AND its path was scanned (or the
+    # run actually produced findings for that path — repo-wide rules
+    # emit root-file findings like README.md regardless of the path
+    # args). The full default scan skips the filter so staleness
+    # reporting stays complete where the baseline is actually written.
+    full_scope = selected is None and sorted(args.paths) == sorted(
+        DEFAULT_PATHS)
+    if entries and not full_scope:
+        scanned = {os.path.relpath(p, args.root).replace(os.sep, "/")
+                   for p in iter_py_files(args.paths, args.root)}
+        produced = {f.path for f in findings}
+        rules_run = set(selected) if selected else set(rules)
+        entries = [e for e in entries
+                   if e.get("rule") in rules_run
+                   and (e.get("path") in scanned
+                        or e.get("path") in produced)]
+    new, old, stale = baseline_mod.split(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "grandfathered": len(old),
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed "
+                  "findings — regenerate with --write-baseline):")
+            for e in stale:
+                print(f"  {e.get('path')}: {e.get('rule')}: "
+                      f"{e.get('message')}")
+        print(f"graftlint: {len(new)} finding"
+              f"{'' if len(new) == 1 else 's'}"
+              f" ({len(old)} grandfathered, "
+              f"{len(findings)} total, "
+              f"rules: {len(selected or rules)})")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Baseline (grandfathered findings) handling.
+
+The checked-in `graftlint_baseline.json` lists findings that predate a
+rule (or are deliberate and too structural for an inline suppression —
+e.g. the predict path's result fetch). Matching is line-number-FREE
+(rule + path + symbol + message), so editing an unrelated part of a
+file neither resurrects nor silently grows the grandfathered set.
+
+Workflow:
+  - new finding -> fix it, suppress it inline (with a reason), or — for
+    pre-existing debt only — add it with `--write-baseline` and review
+    the diff;
+  - fixed finding -> its entry goes STALE; the CLI reports stale
+    entries so the baseline only ever shrinks (`--write-baseline`
+    drops them).
+
+Policy (ISSUE 4): the baseline must stay EMPTY for
+`code2vec_tpu/serving/` and `code2vec_tpu/obs/` — the threaded serving
+layer and the telemetry registry are exactly where these hazard classes
+are bugs, not debt. tests/test_graftlint.py enforces that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from tools.graftlint.core import Finding, REPO_ROOT
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
+
+# baselining is forbidden under these trees (ISSUE 4 acceptance)
+NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/")
+
+
+def _entry(f: Finding) -> Dict[str, str]:
+    return {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+            "message": f.message}
+
+
+def _entry_key(e: Dict[str, str]) -> Tuple[str, str, str, str]:
+    return (e.get("rule", ""), e.get("path", ""), e.get("symbol", ""),
+            e.get("message", ""))
+
+
+def load(path: str = DEFAULT_BASELINE) -> List[Dict[str, str]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def split(findings: Sequence[Finding], entries: Sequence[Dict[str, str]]
+          ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+    """-> (new, grandfathered, stale_entries). Duplicate-aware: N
+    identical findings need N baseline entries (a second instance of a
+    grandfathered hazard is NEW)."""
+    budget: Dict[tuple, int] = {}
+    for e in entries:
+        budget[_entry_key(e)] = budget.get(_entry_key(e), 0) + 1
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in entries:
+        k = _entry_key(e)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return new, old, stale
+
+
+def write(findings: Sequence[Finding],
+          path: str = DEFAULT_BASELINE) -> List[Finding]:
+    """Write the baseline from a finding list, REFUSING entries under
+    the no-baseline trees (those must be fixed or inline-suppressed).
+    Returns the refused findings."""
+    refused = [f for f in findings
+               if f.path.startswith(NO_BASELINE_PREFIXES)]
+    kept = [f for f in findings
+            if not f.path.startswith(NO_BASELINE_PREFIXES)]
+    data = {
+        "_comment": (
+            "graftlint grandfathered findings. Matched by "
+            "rule+path+symbol+message (line-insensitive). Fix entries "
+            "and regenerate with --write-baseline; never baseline "
+            f"findings under {', '.join(NO_BASELINE_PREFIXES)} "
+            "(tests/test_graftlint.py enforces this)."),
+        "findings": [_entry(f) for f in kept],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return refused

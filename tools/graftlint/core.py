@@ -1,0 +1,269 @@
+"""graftlint engine: file loading, suppressions, rule registry, runner.
+
+Design constraints (tools/graftlint/__init__.py has the why):
+
+  - PURE AST: scanned files are parsed, never imported — a lint run can
+    not trigger a jax platform init, a TF import, or module-level side
+    effects, and a file that fails to import (missing optional dep)
+    still gets linted.
+  - One parse per file: every rule sees the same `FileContext` (source,
+    AST, suppression table), so the whole suite is one O(files) walk.
+  - Findings are baseline-matched WITHOUT line numbers (rule + path +
+    symbol + message): editing an unrelated part of a file must not
+    resurrect a grandfathered finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+# repo root = the directory holding tools/ (pytest.ini, config, README
+# all resolve relative to it); rules that need repo-level files take an
+# explicit root so fixtures can point them elsewhere.
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the tier-1 scan set (ROADMAP tier-1 runs the suite over exactly this)
+DEFAULT_PATHS = ("code2vec_tpu", "tools", "tests")
+
+# never scanned: bytecode, native build trees, and the lint fixtures
+# (deliberate true positives — scanning them would fail the repo run)
+EXCLUDE_DIRS = frozenset({"__pycache__", "graftlint_fixtures", "build",
+                          ".git", ".claude"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<file>-file)?=(?P<rules>[\w,-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. `symbol` is the enclosing def/class qualname
+    (baseline stability: line numbers shift, symbols rarely do).
+    `detail` is context that may legitimately change when UNRELATED
+    code moves (e.g. which hot root first reached a function — BFS
+    order); it is rendered but kept OUT of the baseline identity, so
+    such drift cannot invalidate grandfathered entries."""
+
+    rule: str
+    path: str      # repo-root-relative, posix separators
+    line: int
+    message: str
+    symbol: str = ""
+    detail: str = ""
+
+    def key(self) -> tuple:
+        """Baseline identity — deliberately line- and detail-free."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        det = f" ({self.detail})" if self.detail else ""
+        return (f"{self.path}:{self.line}: {self.rule}{sym}: "
+                f"{self.message}{det}")
+
+
+class FileContext:
+    """One parsed source file: AST + the suppression table.
+
+    A `# graftlint: disable=<rules>` comment suppresses matching
+    findings on its OWN line and on the NEXT line (so it can trail the
+    offending statement or sit on its own line above it);
+    `disable-file=` suppresses for the whole file. Rule name `all`
+    matches every rule.
+    """
+
+    def __init__(self, path: str, root: str = REPO_ROOT):
+        self.path = os.path.abspath(path)
+        self.root = root
+        self.rel = os.path.relpath(self.path, root).replace(os.sep, "/")
+        with open(self.path, "r", encoding="utf-8",
+                  errors="replace") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=self.path)
+        self.line_suppressed: Dict[int, Set[str]] = {}
+        self.file_suppressed: Set[str] = set()
+        self._collect_suppressions()
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenError:
+            comments = []
+        for line, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if m.group("file"):
+                self.file_suppressed |= rules
+            else:
+                for ln in (line, line + 1):
+                    self.line_suppressed.setdefault(ln, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for pool in (self.file_suppressed,
+                     self.line_suppressed.get(line, ())):
+            if rule in pool or "all" in pool:
+                return True
+        return False
+
+
+class Rule:
+    """One named check. Per-file rules implement `check_file`; rules
+    needing the whole scan set (call graphs, cross-file consistency)
+    implement `check_repo`. A rule may implement both."""
+
+    name: str = ""
+    description: str = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, ctxs: Sequence[FileContext],
+                   root: str) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate + register a Rule by its name."""
+    rule = rule_cls()
+    assert rule.name and rule.name not in _REGISTRY, rule_cls
+    _REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+def _load_rules() -> None:
+    if _REGISTRY:
+        return
+    # importing the package registers every rule module
+    import tools.graftlint.rules  # noqa: F401
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rules()
+    return dict(_REGISTRY)
+
+
+def get_rule(name: str) -> Rule:
+    _load_rules()
+    return _REGISTRY[name]
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    """Expand files/dirs into a sorted .py file list (excludes
+    EXCLUDE_DIRS at any depth)."""
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            # a typo'd path silently scanning zero files would report
+            # "clean" (and mark the whole baseline stale) — fail loud
+            raise FileNotFoundError(f"graftlint: no such path: {p}")
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS)
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+def run_lint(paths: Sequence[str] = DEFAULT_PATHS,
+             root: str = REPO_ROOT,
+             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Parse every file once, run the selected rules, apply inline
+    suppressions, return findings sorted by (path, line, rule).
+    Baseline filtering is the caller's concern (tools/graftlint/
+    baseline.py) — this returns EVERYTHING the rules see."""
+    _load_rules()
+    selected = [_REGISTRY[r] for r in rules] if rules \
+        else list(_REGISTRY.values())
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths, root):
+        try:
+            ctxs.append(FileContext(path, root))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="parse-error",
+                path=os.path.relpath(path, root).replace(os.sep, "/"),
+                line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}"))
+    by_rel = {c.rel: c for c in ctxs}
+    for rule in selected:
+        for ctx in ctxs:
+            findings.extend(rule.check_file(ctx))
+        findings.extend(rule.check_repo(ctxs, root))
+    kept = []
+    for f in findings:
+        ctx = by_rel.get(f.path)
+        if ctx is not None and ctx.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+# ---- shared AST helpers (used by several rules) ----
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of a call: foo(...) -> 'foo', a.b.c(...) -> 'c'."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for a Name/Attribute chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def walk_body(node: ast.AST, *, into_defs: bool = False):
+    """Walk a def/class body WITHOUT descending into nested function /
+    class definitions (they are separate symbols with their own
+    reachability / lock context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not into_defs and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,13 @@
+"""Rule modules — importing this package registers every rule.
+
+Adding a rule (README "Static analysis" has the user-facing steps):
+  1. new module here with a `@register`-decorated `Rule` subclass
+     (per-file `check_file`, repo-wide `check_repo`, or both);
+  2. a true-positive AND a tricky false-positive fixture under
+     tests/graftlint_fixtures/ + assertions in tests/test_graftlint.py;
+  3. run `python -m tools.graftlint` — fix or baseline what the new
+     rule surfaces (never baseline under serving/ or obs/).
+"""
+
+from tools.graftlint.rules import (config_drift, host_sync,  # noqa: F401
+                                   lock_discipline, retrace, test_markers)

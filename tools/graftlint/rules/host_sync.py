@@ -1,0 +1,280 @@
+"""host-sync-in-hot-path: host transfers inside the latency-critical
+call graph.
+
+The hot paths — the jitted train/eval/predict steps, the model's
+`predict_device`, and the serving batcher's flush loop — must never
+block on a host<->device transfer the author didn't budget for:
+`.item()`, `float()/int()` on a device value, `np.asarray` /
+`jax.device_get`, `print` of a device value, or a bare
+`block_until_ready`. One stray sync serializes the dispatch pipeline
+(BASELINE.md's timing methodology: ~60 ms per sync round-trip on the
+tunneled platform) and is invisible to pytest because nothing is wrong,
+only slow.
+
+Mechanics: build a name-resolved static call graph over the scan set,
+BFS from the hot roots, and scan every reachable function body. Roots:
+
+  - any function carrying a jit/pmap/pjit decorator (the steps);
+  - `Code2VecModel.predict_device` (the serving device phase);
+  - `MicroBatcher._run` and `PredictionServer._run_batch` (the batcher
+    flush path — `_batch_fn` is a constructor-injected indirection the
+    static graph cannot see through, so both sides are roots).
+
+Sanctioned sync points (not flagged, not traversed): `device_sync` and
+`_Span.stop` — the obs helpers whose WHOLE JOB is the explicit,
+telemetry-attributed sync (`span(...).stop(sync=tree)`). Deliberate
+fetches that end a hot path (e.g. `fetch_global` bringing predict
+results to the host) belong in the baseline with their justification,
+not in this exception list: the rule should notice when a NEW sync
+joins them.
+
+Call resolution is heuristic by design (plain `ast`, no imports):
+simple names resolve within the module then to a globally-unique def;
+`self.x(...)` resolves within the class; other attribute calls resolve
+only when the method name is defined exactly once repo-wide and is not
+a common container-protocol name. Unresolvable calls end traversal —
+the rule under-reaches rather than spraying false paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  is_self_attr, register, walk_body)
+
+RULE = "host-sync-in-hot-path"
+
+_JIT_NAMES = frozenset({"jit", "pmap", "pjit"})
+
+# (class, function) hot roots the call graph cannot discover itself
+_ROOT_METHODS = frozenset({
+    ("Code2VecModel", "predict_device"),
+    ("MicroBatcher", "_run"),
+    ("PredictionServer", "_run_batch"),
+})
+
+# the obs-layer explicit sync helpers (module docstring has the policy)
+_SANCTIONED = frozenset({("", "device_sync"), ("_Span", "stop")})
+
+# attribute-call names too generic to resolve by global uniqueness
+# (container/protocol vocabulary — resolving `.get()` to some class's
+# `get` would build fantasy edges)
+_GENERIC_ATTRS = frozenset({
+    "get", "put", "items", "keys", "values", "append", "add", "update",
+    "pop", "close", "open", "read", "write", "run", "start", "stop",
+    "join", "split", "copy", "clear", "count", "index", "sort", "submit",
+})
+
+# numpy module aliases whose `.asarray` is a device->host fetch when fed
+# a jax array (jnp.asarray is host->device and is NOT flagged)
+_NP_ALIASES = frozenset({"np", "numpy", "onp"})
+
+
+@dataclasses.dataclass
+class _Fn:
+    """One function definition in the scan set."""
+    ctx: FileContext
+    node: ast.AST           # FunctionDef / AsyncFunctionDef
+    cls: str                # enclosing class name ('' at module level)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.ctx.rel, self.cls, self.name)
+
+
+def _has_jit_decorator(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", ()):
+        for n in ast.walk(dec):
+            if isinstance(n, ast.Name) and n.id in _JIT_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _JIT_NAMES:
+                return True
+    return False
+
+
+def _mentions_shape_math(node: ast.AST) -> bool:
+    """True when an expression is shape/dtype bookkeeping, not a device
+    value: touching .shape/.ndim/.size/.dtype/len() or made purely of
+    constants. float(loss) flags; int(x.shape[0]) does not."""
+    all_const = True
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(n, ast.Call) and call_name(n) == "len":
+            return True
+        if not isinstance(n, (ast.Constant, ast.BinOp, ast.UnaryOp,
+                              ast.operator, ast.unaryop, ast.expr_context,
+                              ast.Tuple, ast.List)):
+            all_const = False
+    return all_const
+
+
+def _index_functions(ctxs: Sequence[FileContext]) -> List[_Fn]:
+    fns: List[_Fn] = []
+    for ctx in ctxs:
+        stack: List[Tuple[ast.AST, str]] = [(ctx.tree, "")]
+        while stack:
+            node, cls = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    fns.append(_Fn(ctx, child, cls))
+                    # nested defs (jitted inner steps) are functions too
+                    stack.append((child, cls))
+                elif isinstance(child, (ast.If, ast.Try, ast.With,
+                                        ast.For, ast.AsyncFor,
+                                        ast.While, ast.ExceptHandler)):
+                    # defs also hide in loop bodies and except-import
+                    # fallbacks — they must be indexable as hot roots
+                    stack.append((child, cls))
+    return fns
+
+
+class _Graph:
+    """Name-heuristic call graph over the indexed functions."""
+
+    def __init__(self, fns: List[_Fn]):
+        self.fns = fns
+        self.by_key = {f.key: f for f in fns}
+        self.by_name: Dict[str, List[_Fn]] = {}
+        for f in fns:
+            self.by_name.setdefault(f.name, []).append(f)
+        # per (file, class): method name -> fn
+        self.methods: Dict[Tuple[str, str], Dict[str, _Fn]] = {}
+        # per file: module-scope function name -> fn
+        self.module_fns: Dict[str, Dict[str, _Fn]] = {}
+        for f in fns:
+            if f.cls:
+                self.methods.setdefault(
+                    (f.ctx.rel, f.cls), {})[f.name] = f
+            else:
+                self.module_fns.setdefault(f.ctx.rel, {})[f.name] = f
+
+    def _unique(self, name: str) -> Optional[_Fn]:
+        hits = self.by_name.get(name, ())
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve_call(self, fn: _Fn, call: ast.Call) -> Optional[_Fn]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self.module_fns.get(fn.ctx.rel, {}).get(func.id)
+            if local is not None:
+                return local
+            return self._unique(func.id)  # imported def elsewhere
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if is_self_attr(func) is not None and fn.cls:
+                mine = self.methods.get((fn.ctx.rel, fn.cls), {}).get(attr)
+                if mine is not None:
+                    return mine
+            if attr in _GENERIC_ATTRS:
+                return None
+            return self._unique(attr)
+        return None
+
+    def callees(self, fn: _Fn) -> Iterable[_Fn]:
+        for node in walk_body(fn.node):
+            if isinstance(node, ast.Call):
+                target = self.resolve_call(fn, node)
+                if target is not None:
+                    yield target
+
+
+def _is_sanctioned(fn: _Fn) -> bool:
+    return ((fn.cls, fn.name) in _SANCTIONED
+            or ("", fn.name) in _SANCTIONED)
+
+
+def _scan_violations(fn: _Fn, root_label: str) -> Iterable[Finding]:
+    # which root reached us is BFS-order-dependent context -> `detail`
+    # (outside the baseline identity), never part of the message
+    via = f"hot path via {root_label}" if root_label != fn.qualname \
+        else ""
+    for node in walk_body(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        msg = None
+        if name == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            msg = ".item() forces a device->host sync"
+        elif name in ("float", "int") and isinstance(node.func, ast.Name) \
+                and len(node.args) == 1 \
+                and not _mentions_shape_math(node.args[0]):
+            msg = (f"{name}() on a runtime value blocks on the device "
+                   "if it is a jax array")
+        elif name == "asarray" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in _NP_ALIASES:
+            msg = "np.asarray fetches device arrays to the host"
+        elif name == "device_get":
+            msg = "jax.device_get is an explicit device->host fetch"
+        elif name == "print" and isinstance(node.func, ast.Name):
+            msg = ("print in a hot function stalls the dispatch queue "
+                   "(and syncs if handed a device value)")
+        elif name == "block_until_ready":
+            msg = ("bare block_until_ready in a hot function (and it "
+                   "can return early on the tunneled platform — "
+                   "BASELINE.md methodology)")
+        if msg:
+            yield Finding(
+                rule=RULE, path=fn.ctx.rel, line=node.lineno,
+                symbol=fn.qualname, detail=via,
+                message=(f"{msg}; use the obs "
+                         "span(...).stop(sync=...) helpers for a "
+                         "deliberate sync, or move this off the hot "
+                         "path"))
+
+
+@register
+class HostSyncRule(Rule):
+    name = RULE
+    description = ("host transfers (.item(), float()/int(), np.asarray, "
+                   "print, bare block_until_ready) in functions "
+                   "reachable from the jitted step / predict / "
+                   "batcher-flush paths")
+
+    def check_repo(self, ctxs: Sequence[FileContext],
+                   root: str) -> Iterable[Finding]:
+        fns = _index_functions(ctxs)
+        graph = _Graph(fns)
+        roots = [f for f in fns
+                 if (_has_jit_decorator(f.node)
+                     or (f.cls, f.name) in _ROOT_METHODS)
+                 and not _is_sanctioned(f)]
+        # BFS; remember which root first reached each function so the
+        # message can say WHY it is considered hot
+        reached: Dict[Tuple[str, str, str], str] = {}
+        queue: List[Tuple[_Fn, str]] = [(f, f.qualname) for f in roots]
+        for f, label in queue:
+            reached.setdefault(f.key, label)
+        i = 0
+        while i < len(queue):
+            fn, label = queue[i]
+            i += 1
+            for callee in graph.callees(fn):
+                if _is_sanctioned(callee) or callee.key in reached:
+                    continue
+                reached[callee.key] = label
+                queue.append((callee, label))
+        findings: List[Finding] = []
+        for fn in fns:
+            label = reached.get(fn.key)
+            if label is None or _is_sanctioned(fn):
+                continue
+            findings.extend(_scan_violations(fn, label))
+        return findings

@@ -1,0 +1,242 @@
+"""lock-discipline: attributes mutated both inside and outside a class's
+lock.
+
+A lightweight static race detector for the threaded layers (the serving
+queue/batcher, the thread-safe telemetry registry): once a class owns a
+lock, EVERY mutation of a given attribute should agree about holding
+it. An attribute written under `with self._lock` in one method and
+bare in another is exactly the race pytest only catches once in a
+thousand runs (the PR-3 batcher lifecycle race was this shape).
+
+Scope: any class that assigns a `threading.Lock/RLock/Condition/
+Semaphore` (or calls `make_threadsafe`-style installers — detected as a
+lock-ish-named self attribute) anywhere in its body. Classes with no
+lock are skipped entirely — a single-threaded dataclass mutating its
+own fields is not a finding (obs.TimerStat is the canonical
+false-positive: ITS thread safety is the OWNING registry's lock).
+
+A mutation is: assignment / augmented assignment to `self.x` or
+`self.x[...]`, or a mutator-method call (`append`, `popleft`,
+`clear`, ...) on `self.x`. "Inside the lock" means lexically within a
+`with` whose context manager is a lock-ish-named self attribute
+(`self._lock`, `self._cond`, `self._lifecycle_lock`) or call
+(`self._guard()`). Exemptions: `__init__`-family methods (construction
+is single-threaded by convention), the lock attributes themselves.
+
+Reads are deliberately out of scope: lock-free reads of
+monotonic/atomic flags are an idiom this codebase uses on purpose
+(`MicroBatcher.running`); racy READ bugs need dynamic tools.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  is_self_attr, register)
+
+RULE = "lock-discipline"
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+# anchored to name SEGMENTS: `_lifecycle_lock`, `_cond`, `_guard` are
+# locks; `_retry_seconds` ('cond') and `_assembled` ('sem') are not
+_LOCKISH_RE = re.compile(
+    r"(^|_)(lock|cond|mutex|guard|sem|semaphore)s?(_|$)", re.IGNORECASE)
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__",
+                           "__init_subclass__"})
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "add", "discard",
+    "update", "setdefault", "move_to_end", "sort", "reverse", "put",
+    "put_nowait",
+})
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in _LOCK_CTORS
+
+
+def _lockish_with_item(item: ast.withitem) -> bool:
+    """`with self._lock:` / `with self._cond:` / `with self._guard():`"""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    attr = is_self_attr(expr)
+    return attr is not None and bool(_LOCKISH_RE.search(attr))
+
+
+def _mutated_attrs(node: ast.AST) -> List[Tuple[str, int]]:
+    """Every (attr, line) this statement mutates on self — a
+    tuple-unpack (`a, self.x = ..., ...`) can mutate several."""
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        if _is_lock_ctor(node.value):
+            # `self.x = threading.Lock()` installs the lock, it does
+            # not race on it (pass 1 collects it as a lock attr)
+            return []
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            return []
+        targets = [node.target]
+    elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+        attr = is_self_attr(node.func.value)
+        return [(attr, node.lineno)] if attr is not None else []
+    # flatten tuple/list unpacking targets
+    out: List[Tuple[str, int]] = []
+    while targets:
+        tgt = targets.pop()
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            targets.extend(tgt.elts)
+            continue
+        if isinstance(tgt, ast.Starred):
+            targets.append(tgt.value)
+            continue
+        while isinstance(tgt, ast.Subscript):  # self.x[k] = v mutates x
+            tgt = tgt.value
+        attr = is_self_attr(tgt)
+        if attr is not None:
+            out.append((attr, node.lineno))
+    return out
+
+
+class _ClassScan(ast.NodeVisitor):
+    """Collect per-attribute (locked_lines, unlocked_lines) over every
+    method of one class."""
+
+    def __init__(self):
+        self.lock_attrs: Set[str] = set()
+        self.locked: Dict[str, List[int]] = {}
+        self.unlocked: Dict[str, List[int]] = {}
+        self.sites: Dict[str, List[str]] = {}
+        self._with_depth = 0
+        self._method = ""
+
+    def scan_method(self, node: ast.FunctionDef) -> None:
+        self._method = node.name
+        self._with_depth = 0
+        for child in node.body:
+            self.visit(child)
+
+    def visit_With(self, node):
+        locked = any(_lockish_with_item(i) for i in node.items)
+        if locked:
+            self._with_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._with_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        # a nested def's body runs at CALL time under whoever calls it;
+        # don't attribute the enclosing method's lock context to it
+        depth, self._with_depth = self._with_depth, 0
+        for child in node.body:
+            self.visit(child)
+        self._with_depth = depth
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _record(self, node: ast.AST) -> None:
+        for attr, line in _mutated_attrs(node):
+            if _LOCKISH_RE.search(attr):
+                self.lock_attrs.add(attr)
+                continue
+            bucket = self.locked if self._with_depth > 0 \
+                else self.unlocked
+            bucket.setdefault(attr, []).append(line)
+            self.sites.setdefault(attr, []).append(
+                f"{self._method}:{line}"
+                f"{' (locked)' if self._with_depth > 0 else ''}")
+
+    def visit_Assign(self, node):
+        if _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                attr = is_self_attr(tgt)
+                if attr is not None:
+                    self.lock_attrs.add(attr)
+        self._record(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record(node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None and _is_lock_ctor(node.value):
+            attr = is_self_attr(node.target)
+            if attr is not None:
+                self.lock_attrs.add(attr)
+        self._record(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._record(node)
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = RULE
+    description = ("in lock-owning classes, attributes mutated both "
+                   "inside and outside `with self._lock` blocks — the "
+                   "static shape of a data race")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [m for m in node.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            scan = _ClassScan()
+            # pass 1: lock declarations anywhere in the class —
+            # `self._lock = threading.Lock()` in any method (__init__
+            # included) OR the class-attribute idiom
+            # (`_lock = threading.Lock()` in the class body, still
+            # taken as `with self._lock:`)
+            for item in node.body:
+                val = getattr(item, "value", None)
+                if isinstance(item, (ast.Assign, ast.AnnAssign)) and \
+                        val is not None and _is_lock_ctor(val):
+                    tgts = item.targets if isinstance(item, ast.Assign) \
+                        else [item.target]
+                    scan.lock_attrs.update(
+                        t.id for t in tgts if isinstance(t, ast.Name))
+            for m in methods:
+                for n in ast.walk(m):
+                    val = getattr(n, "value", None)
+                    if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                            val is not None and _is_lock_ctor(val):
+                        tgts = n.targets if isinstance(n, ast.Assign) \
+                            else [n.target]
+                        for tgt in tgts:
+                            attr = is_self_attr(tgt)
+                            if attr is not None:
+                                scan.lock_attrs.add(attr)
+            # pass 2: mutation sites — construction methods exempt
+            # (single-threaded by convention; racing on a half-built
+            # object is a different bug class)
+            for m in methods:
+                if m.name not in _INIT_METHODS:
+                    scan.scan_method(m)
+            if not scan.lock_attrs:
+                continue
+            for attr in sorted(set(scan.locked) & set(scan.unlocked)):
+                sites = ", ".join(scan.sites.get(attr, []))
+                line = scan.unlocked[attr][0]
+                findings.append(Finding(
+                    rule=RULE, path=ctx.rel, line=line,
+                    symbol=f"{node.name}.{attr}",
+                    message=(f"self.{attr} is mutated both under "
+                             f"{'/'.join(sorted(scan.lock_attrs))} and "
+                             f"without it ({sites}) — take the lock at "
+                             "every mutation site or document the "
+                             "attribute as single-threaded")))
+        return findings

@@ -1,0 +1,254 @@
+"""retrace-hazard: jit call-site patterns that defeat the compile cache.
+
+The serving layer's zero-compilations-under-load guarantee (PR 3:
+warmed power-of-two predict buckets) and the train loop's
+compile-once-per-shape contract both die quietly when a call site
+re-traces: latency spikes of seconds under load, nothing fails. The
+hazard patterns this rule catches, all statically visible:
+
+  1. jit-in-loop / re-jit: `jax.jit(...)` evaluated inside a for/while
+     body — every iteration builds a FRESH callable with an empty
+     compile cache;
+  2. immediate invocation: `jax.jit(f)(x)` — same storm, one-liner
+     form;
+  3. invalid statics: `static_argnums=` / `static_argnames=` values
+     that are not int/str constants (or tuples/lists thereof) — a
+     runtime-computed or unhashable static turns the cache key into a
+     moving target (unhashable values raise, dynamic ones silently
+     fragment the cache);
+  4. Python scalar / dict literal passed positionally to a
+     known-jitted callable — weak-typed scalars promote per call
+     pattern and dict literals rebuild their pytree structure at every
+     site; pass arrays, or mark the argument static;
+  5. shape-derived branching around a jitted call: an `if` testing
+     `.shape` in a function that calls a jitted callable compiles one
+     variant per branch outcome — bucket shapes explicitly instead
+     (the `predict_bucket_size` pow-2 pattern).
+
+"Known-jitted" = names bound (locally or on self) from `jax.jit` /
+`pmap` / `pjit` or from a `make_*step` factory (the repo idiom:
+training/steps.py returns jitted steps).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  is_self_attr, register, walk_body)
+
+RULE = "retrace-hazard"
+
+_JIT_NAMES = frozenset({"jit", "pmap", "pjit"})
+_FACTORY_RE = re.compile(r"^make_\w*step$")
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """`jax.jit(...)` / `jit(...)` / `functools.partial(jax.jit, ...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if name == "partial" and node.args:
+        return _is_jit_ref(node.args[0])
+    return False
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id in _JIT_NAMES) or (
+        isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES)
+
+
+def _static_kwarg_invalid(value: ast.AST, want) -> bool:
+    """True when a static_argnums/static_argnames value is not a
+    constant of the expected scalar type or a tuple/list of them."""
+    def ok_scalar(n: ast.AST) -> bool:
+        return isinstance(n, ast.Constant) and isinstance(n.value, want) \
+            and not isinstance(n.value, bool)
+
+    if ok_scalar(value):
+        return False
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return not all(ok_scalar(e) for e in value.elts)
+    return True
+
+
+def _is_jitted_value(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (
+        _is_jit_call(node) or bool(_FACTORY_RE.match(call_name(node))))
+
+
+def _jitted_names_shallow(scope: ast.AST) -> Set[str]:
+    """NAMES bound from jit calls / make_*step factories in exactly
+    this scope (module or one function body) — walk_body stops at
+    nested defs, so a jit binding in one function never leaks into an
+    unrelated function that reuses the name."""
+    out: Set[str] = set()
+    for node in walk_body(scope):
+        if isinstance(node, ast.Assign) and _is_jitted_value(node.value):
+            out.update(t.id for t in node.targets
+                       if isinstance(t, ast.Name))
+    return out
+
+
+def _jitted_self_attrs(cls: ast.ClassDef) -> Set[str]:
+    """`self.x = jax.jit(...)` / `self.x = make_*step(...)` anywhere in
+    the class — instance attributes are visible to every method (the
+    `self._predict_step` idiom), unlike local names."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_jitted_value(node.value):
+            for tgt in node.targets:
+                attr = is_self_attr(tgt)
+                if attr:
+                    out.add(attr)
+    return out
+
+
+def _calls_jitted(node: ast.Call, jitted: Set[str]) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in jitted
+    attr = is_self_attr(f)
+    return attr is not None and attr in jitted
+
+
+def _mentions_shape(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr == "shape"
+               for n in ast.walk(node))
+
+
+@register
+class RetraceRule(Rule):
+    name = RULE
+    description = ("jit/pmap/pjit usage that defeats the compile cache: "
+                   "jit-in-loop, jit(f)(x), non-constant/unhashable "
+                   "statics, scalar/dict literals as traced args, "
+                   "shape-derived branching around jitted calls")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # TOP-LEVEL jitted bindings are visible everywhere in-file;
+        # function-local ones are pushed/popped per scope below
+        module_jitted = _jitted_names_shallow(ctx.tree)
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+                self.fn_stack: List[str] = []
+                self.jitted_stack: List[Set[str]] = [module_jitted]
+
+            @property
+            def symbol(self) -> str:
+                return ".".join(self.fn_stack)
+
+            @property
+            def jitted(self) -> Set[str]:
+                return set().union(*self.jitted_stack)
+
+            def _finding(self, node: ast.AST, message: str) -> None:
+                findings.append(Finding(
+                    rule=RULE, path=ctx.rel, line=node.lineno,
+                    symbol=self.symbol, message=message))
+
+            def visit_FunctionDef(self, node):
+                self.fn_stack.append(node.name)
+                self.jitted_stack.append(_jitted_names_shallow(node))
+                # loop state does not leak into a nested def's body
+                # (defining a function in a loop is fine; CALLING jit
+                # there is not — the call is what visit_Call sees)
+                depth, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = depth
+                self.jitted_stack.pop()
+                self.fn_stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_ClassDef(self, node):
+                self.fn_stack.append(node.name)
+                self.jitted_stack.append(_jitted_self_attrs(node))
+                self.generic_visit(node)
+                self.jitted_stack.pop()
+                self.fn_stack.pop()
+
+            def _visit_loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_For = _visit_loop
+            visit_While = _visit_loop
+
+            def visit_If(self, node):
+                if _mentions_shape(node.test):
+                    jitted = self.jitted
+                    for n in ast.walk(node):
+                        if isinstance(n, ast.Call) and _calls_jitted(
+                                n, jitted):
+                            self._finding(
+                                node,
+                                "shape-derived branch around a jitted "
+                                "call — each branch outcome compiles a "
+                                "new variant under load; pad to "
+                                "explicit shape buckets instead "
+                                "(predict_bucket_size pattern)")
+                            break
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                if _is_jit_call(node):
+                    if self.loop_depth > 0:
+                        self._finding(
+                            node,
+                            "jit/pmap/pjit evaluated inside a loop — "
+                            "each iteration builds a fresh callable "
+                            "with an empty compile cache; hoist it out")
+                    for kw in node.keywords:
+                        if kw.arg == "static_argnums" and \
+                                _static_kwarg_invalid(kw.value, int):
+                            self._finding(
+                                kw.value,
+                                "static_argnums must be a literal int "
+                                "or tuple of ints — computed/unhashable "
+                                "statics fragment (or break) the "
+                                "compile cache")
+                        if kw.arg == "static_argnames" and \
+                                _static_kwarg_invalid(kw.value, str):
+                            self._finding(
+                                kw.value,
+                                "static_argnames must be a literal str "
+                                "or tuple of strs — computed statics "
+                                "fragment the compile cache")
+                if isinstance(node.func, ast.Call) and _is_jit_call(
+                        node.func):
+                    self._finding(
+                        node,
+                        "jit(f)(args) compiles on EVERY call (the "
+                        "jitted callable is discarded immediately); "
+                        "bind it once and reuse it")
+                if _calls_jitted(node, self.jitted):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Dict):
+                            self._finding(
+                                arg,
+                                "dict literal passed to a jitted "
+                                "callable — the pytree structure is "
+                                "rebuilt at every call site; pass a "
+                                "stable container built once")
+                        elif isinstance(arg, ast.Constant) and \
+                                isinstance(arg.value, (int, float)) \
+                                and not isinstance(arg.value, bool):
+                            self._finding(
+                                arg,
+                                "Python scalar literal passed as a "
+                                "traced arg — weak-typed scalars risk "
+                                "a retrace per call pattern; pass an "
+                                "array or mark the argument static")
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings

@@ -1,0 +1,140 @@
+"""test-marker-hygiene: the tier-1 budget is guarded by markers, so
+markers must be real.
+
+Tier-1 runs `-m 'not slow'` (ROADMAP.md). That deselection only works
+when (a) the `slow` marker is REGISTERED in pytest.ini and (b) slow
+tests actually CARRY it. test_requant_sweep.py / test_loadgen.py each
+hand-rolled a guard for (a); this rule generalizes both directions over
+every test file:
+
+  - unknown marker: `@pytest.mark.X` (or `pytest.param(...,
+    marks=...)`) where X is neither a pytest builtin nor registered in
+    pytest.ini — a typo'd `slwo` would silently RUN in tier-1, the
+    exact failure the hand-rolled guards exist to prevent;
+  - unmarked long-runner: a test function without `@pytest.mark.slow`
+    whose body (statically) commits to a long run — `time.sleep(C)`
+    with a constant C >= 1.0 second, or driving a CLI with the
+    `--duration` long-run flag. The sub-second sleeps the
+    server/prefetch tests use for thread handoff stay below the
+    threshold on purpose.
+
+pytest.ini parsing is textual (the `markers =` block); registered
+marker = the token before the first `:`.
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import os
+from typing import Iterable, List, Optional, Sequence, Set
+
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  dotted_name, register)
+
+RULE = "test-marker-hygiene"
+
+_BUILTIN_MARKS = frozenset({
+    "skip", "skipif", "xfail", "parametrize", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+})
+
+_SLEEP_THRESHOLD_S = 1.0
+
+
+def registered_markers(pytest_ini: str) -> Set[str]:
+    if not os.path.exists(pytest_ini):
+        return set()
+    cp = configparser.ConfigParser()
+    cp.read(pytest_ini)
+    if not cp.has_option("pytest", "markers"):
+        return set()
+    out = set()
+    for line in cp.get("pytest", "markers").splitlines():
+        line = line.strip()
+        if line:
+            out.add(line.split(":", 1)[0].split("(", 1)[0].strip())
+    return out
+
+
+def _mark_names(node: ast.AST) -> Iterable[ast.Attribute]:
+    """Every `pytest.mark.X` attribute under `node`."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and dotted_name(n).startswith(
+                "pytest.mark."):
+            yield n
+
+
+def _is_test_file(ctx: FileContext) -> bool:
+    base = os.path.basename(ctx.rel)
+    return (base.startswith("test_") or base == "conftest.py"
+            or "/tests/" in f"/{ctx.rel}")
+
+
+def _has_slow_mark(fn: ast.AST) -> bool:
+    return any(m.attr == "slow"
+               for dec in getattr(fn, "decorator_list", ())
+               for m in _mark_names(dec))
+
+
+def _long_run_reason(fn: ast.AST) -> Optional[ast.AST]:
+    """First node that commits this test to a long run, else None."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and call_name(n) == "sleep" \
+                and n.args and isinstance(n.args[0], ast.Constant) \
+                and isinstance(n.args[0].value, (int, float)) \
+                and n.args[0].value >= _SLEEP_THRESHOLD_S:
+            return n
+        if isinstance(n, ast.Constant) and n.value == "--duration":
+            return n
+    return None
+
+
+@register
+class TestMarkerRule(Rule):
+    name = RULE
+    description = ("unregistered pytest markers (typo'd `slow` runs in "
+                   "tier-1) and long-running tests (sleep >= 1 s, "
+                   "--duration CLI runs) missing @pytest.mark.slow")
+
+    def check_ctx(self, ctx: FileContext,
+                  registered: Set[str]) -> Iterable[Finding]:
+        known = registered | _BUILTIN_MARKS
+        findings: List[Finding] = []
+        for mark in _mark_names(ctx.tree):
+            if mark.attr not in known:
+                findings.append(Finding(
+                    rule=RULE, path=ctx.rel, line=mark.lineno,
+                    symbol=f"pytest.mark.{mark.attr}",
+                    message=(f"marker '{mark.attr}' is not registered "
+                             "in pytest.ini (and is no pytest "
+                             "builtin) — a typo here silently defeats "
+                             "tier-1's `-m 'not slow'` deselection")))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_") or _has_slow_mark(node):
+                continue
+            reason = _long_run_reason(node)
+            if reason is not None:
+                what = ("a constant sleep >= "
+                        f"{_SLEEP_THRESHOLD_S:g} s"
+                        if isinstance(reason, ast.Call)
+                        else "a --duration long-run CLI invocation")
+                findings.append(Finding(
+                    rule=RULE, path=ctx.rel, line=reason.lineno,
+                    symbol=node.name,
+                    message=(f"test contains {what} but carries no "
+                             "@pytest.mark.slow — tier-1 pays for it "
+                             "on every run")))
+        return findings
+
+    def check_repo(self, ctxs: Sequence[FileContext],
+                   root: str) -> Iterable[Finding]:
+        registered = registered_markers(os.path.join(root, "pytest.ini"))
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            if _is_test_file(ctx):
+                findings.extend(self.check_ctx(ctx, registered))
+        return findings
