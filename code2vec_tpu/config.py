@@ -149,6 +149,16 @@ class Config:
     # For high-latency links (the tunneled dev platform: ~200 ms per
     # transfer round trip); single-device only — ignored with a mesh.
     INFEED_CHUNK: int = 1
+    # Async epoch checkpointing (training/checkpoint.py
+    # AsyncCheckpointWriter): the train loop snapshots params/opt_state
+    # with a cheap on-device copy and a background thread does the
+    # device fetch + orbax write + pruning, so the loop's blocked time
+    # per checkpoint is a small constant instead of the save wall time
+    # (eval overlaps the writer tail; hard commit barrier at end of
+    # training). `--async_checkpoint off` restores the synchronous save
+    # (identical checkpoint directory layout) for A/B measurement —
+    # tools/epoch_overhead.py drives the comparison.
+    ASYNC_CHECKPOINT: bool = True
 
     # ---- batched serving (serving/server.py + serving/batcher.py):
     # a thread-safe request queue feeding a dynamic micro-batcher that
@@ -389,6 +399,12 @@ class Config:
                        type=int, default=None,
                        help="batches per host->device transfer "
                             "(latency amortization; 1 = off)")
+        p.add_argument("--async_checkpoint", dest="async_checkpoint",
+                       default=None, choices=["on", "off"],
+                       help="background checkpoint writer (default on):"
+                            " epoch saves block the train loop only for"
+                            " an on-device snapshot; 'off' restores the"
+                            " synchronous save for A/B measurement")
         p.add_argument("--sampled_softmax", dest="sampled_softmax",
                        action="store_true")
         p.add_argument("--num_sampled", dest="num_sampled", type=int, default=None)
@@ -564,6 +580,8 @@ class Config:
             cfg.INFEED_PREFETCH = ns.infeed_prefetch
         if ns.infeed_chunk is not None:
             cfg.INFEED_CHUNK = ns.infeed_chunk
+        if ns.async_checkpoint is not None:
+            cfg.ASYNC_CHECKPOINT = ns.async_checkpoint == "on"
         if ns.sampled_softmax:
             cfg.USE_SAMPLED_SOFTMAX = True
         if ns.num_sampled is not None:

@@ -30,6 +30,7 @@ import threading
 from typing import Callable, Iterable, Iterator, Tuple
 
 _SENTINEL = object()
+_EPOCH_END = object()
 
 
 class _ThreadedInfeed:
@@ -206,6 +207,93 @@ def prefetch_to_device(batches: Iterable, put_fn: Callable,
     if depth <= 0:
         return _SyncInfeed(batches, put_fn)
     return DevicePrefetcher(batches, put_fn, depth)
+
+
+def persistent_epochs(infeed, num_epochs: int
+                      ) -> Iterator[Tuple[int, Iterator[Tuple]]]:
+    """Keep the infeed producer WARM across epoch boundaries.
+
+    Yields `(epoch, epoch_batches)` pairs, 1-based. For a threaded
+    infeed, ONE producer thread runs all `num_epochs` passes over the
+    reader back-to-back, separating them with an epoch-end marker in
+    the shared queue — so while the consumer is doing epoch-boundary
+    work (checkpoint save, eval), the producer is already parsing and
+    transferring epoch k+1's first batches instead of cold-restarting a
+    fresh thread and re-filling the double buffer from scratch.
+    Per-epoch shuffle semantics are preserved exactly: each pass is one
+    `iter(reader)`, which advances the reader's `_epoch` counter and
+    draws that epoch's seeded permutation, same as the cold path.
+
+    The synchronous A/B control (`--infeed_prefetch 0` -> _SyncInfeed)
+    re-iterates cold per epoch — persistence is inherently threaded and
+    must not confound the no-thread measurement.
+
+    The consumer must drain each epoch's iterator before taking the
+    next pair (a `for` over the pair's iterator does); abandoning the
+    generator mid-run (exception in the step loop) releases the
+    producer thread and its device-resident batches via the `finally`
+    drain, exactly like `_ThreadedInfeed.__iter__`.
+    """
+    if not isinstance(infeed, _ThreadedInfeed):
+        for epoch in range(1, num_epochs + 1):
+            yield epoch, iter(infeed)
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=infeed._depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run() -> None:
+        try:
+            for _ in range(num_epochs):
+                infeed._produce(put)
+                if not put((_EPOCH_END, None)):
+                    return
+        except BaseException as e:  # surfaces at the consumer position
+            put((_SENTINEL, e))
+            return
+        put((_SENTINEL, None))
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="train-infeed")
+    thread.start()
+    finished = threading.Event()  # producer exhausted (error or done):
+    #                               later epochs must not block on q.get
+
+    def epoch_iter() -> Iterator[Tuple]:
+        if finished.is_set():
+            return
+        while True:
+            item = q.get()
+            if item[0] is _EPOCH_END:
+                return
+            if item[0] is _SENTINEL:
+                finished.set()
+                thread.join()
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield from infeed._emit(item)
+
+    try:
+        for epoch in range(1, num_epochs + 1):
+            yield epoch, epoch_iter()
+    finally:
+        stop.set()
+        while thread.is_alive():  # drain so a blocked put returns
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.05)
 
 
 def build_train_infeed(reader: Iterable, *, chunk: int, depth: int,

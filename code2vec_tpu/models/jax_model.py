@@ -250,6 +250,10 @@ class Code2VecModel(Code2VecModelBase):
                 use_pallas=self.use_pallas, mesh=self.mesh,
                 augment_fn=augment_fn,
                 requant_fused=resolve_requant_mode(cfg.REQUANT_PALLAS))
+        # background checkpoint writer (--async_checkpoint, default on):
+        # created lazily at the first save so load/predict-only model
+        # instances never start the thread
+        self._ckpt_writer: Optional[ckpt.AsyncCheckpointWriter] = None
         top_k = cfg.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
         self._eval_step = make_eval_step(self.dims, top_k=top_k,
                                          compute_dtype=self.compute_dtype,
@@ -335,67 +339,94 @@ class Code2VecModel(Code2VecModelBase):
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", scalar_writer=scalars, log=self.log)
         self.telemetry = telemetry
+        if cfg.ASYNC_CHECKPOINT:
+            # the background writer records save_total_ms from its own
+            # thread into this registry
+            telemetry.make_threadsafe()
         recorder = TrainStepRecorder(
             telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
         # host->device transfer of batch k+1 overlap step k on a daemon
         # thread; the loop below never blocks on the host between steps.
+        # persistent_epochs keeps the SAME producer thread warm across
+        # epoch boundaries (it parses/transfers epoch k+1 while the
+        # boundary save + eval run) instead of cold-restarting it and
+        # re-filling the double buffer each epoch.
+        from code2vec_tpu.data.prefetch import persistent_epochs
         infeed = self._train_infeed(reader)
-        for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for dev_batch, batch in recorder.wrap(infeed):
-                profiler.tick(steps_into_training, self.params)
-                self.rng, step_rng = jax.random.split(self.rng)
-                self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state, dev_batch, step_rng)
-                self.step_num += 1
-                steps_into_training += 1
-                window_examples += batch.num_valid_examples
-                loss_f = (recorder.end_step(self.step_num, loss,
-                                            batch.num_valid_examples)
-                          if recorder.enabled else None)
-                if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
-                    if loss_f is None:
-                        # device sync only on log steps
-                        loss_f = float(loss)
-                    dt = time.time() - window_start
-                    ex_s = window_examples / max(dt, 1e-9)
-                    # path-contexts/sec = examples/sec * MAX_CONTEXTS —
-                    # the BASELINE.json metric (SURVEY.md §4.2).
-                    self.log(
-                        f"epoch {epoch} step {self.step_num}: "
-                        f"loss {loss_f:.4f}, {ex_s:.1f} ex/s, "
-                        f"{ex_s * cfg.MAX_CONTEXTS:.0f} path-contexts/s")
-                    scalars.write(self.step_num, {
-                        "train/loss": loss_f,
-                        "train/examples_per_sec": ex_s,
-                        "train/path_contexts_per_sec":
-                            ex_s * cfg.MAX_CONTEXTS})
-                    window_examples, window_start = 0, time.time()
-            epoch_end_work = False
-            if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                with telemetry.timed("train/save_ms"):
-                    self.save(cfg.save_path)
-                epoch_end_work = True
-            if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                with telemetry.timed("train/eval_ms"):
+        try:
+            for epoch, epoch_batches in persistent_epochs(
+                    infeed, cfg.NUM_TRAIN_EPOCHS):
+                for dev_batch, batch in recorder.wrap(epoch_batches):
+                    profiler.tick(steps_into_training, self.params)
+                    self.rng, step_rng = jax.random.split(self.rng)
+                    self.params, self.opt_state, loss = self._train_step(
+                        self.params, self.opt_state, dev_batch, step_rng)
+                    self.step_num += 1
+                    steps_into_training += 1
+                    window_examples += batch.num_valid_examples
+                    loss_f = (recorder.end_step(self.step_num, loss,
+                                                batch.num_valid_examples)
+                              if recorder.enabled else None)
+                    if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
+                        if loss_f is None:
+                            # device sync only on log steps
+                            loss_f = float(loss)
+                        dt = time.time() - window_start
+                        ex_s = window_examples / max(dt, 1e-9)
+                        # path-contexts/sec = examples/sec * MAX_CONTEXTS —
+                        # the BASELINE.json metric (SURVEY.md §4.2).
+                        self.log(
+                            f"epoch {epoch} step {self.step_num}: "
+                            f"loss {loss_f:.4f}, {ex_s:.1f} ex/s, "
+                            f"{ex_s * cfg.MAX_CONTEXTS:.0f} path-contexts/s")
+                        scalars.write(self.step_num, {
+                            "train/loss": loss_f,
+                            "train/examples_per_sec": ex_s,
+                            "train/path_contexts_per_sec":
+                                ex_s * cfg.MAX_CONTEXTS})
+                        window_examples, window_start = 0, time.time()
+                epoch_end_work = False
+                if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                    # kick the save FIRST (async: returns after the
+                    # snapshot) so eval below runs while the writer drains —
+                    # boundary cost ~ max(eval, save tail), not save + eval
+                    self.save(cfg.save_path, block=False)
+                    epoch_end_work = True
+                if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                    eval_span = telemetry.span("train/eval_ms")
                     results = self.evaluate()
-                self.log(f"epoch {epoch} evaluation: {results}")
-                scalars.write(self.step_num, {
-                    "eval/loss": results.loss,
-                    "eval/top1": results.topk_acc[0],
-                    "eval/subtoken_f1": results.subtoken_f1,
-                    "eval/subtoken_precision": results.subtoken_precision,
-                    "eval/subtoken_recall": results.subtoken_recall})
-                telemetry.event("eval", epoch=epoch, step=self.step_num,
-                                loss=results.loss,
-                                subtoken_f1=results.subtoken_f1)
-                epoch_end_work = True
-            if epoch_end_work:
-                # reset the throughput window: checkpoint + eval wall
-                # time must not be silently absorbed into the next
-                # epoch's first ex/s figure
-                window_examples, window_start = 0, time.time()
+                    eval_ms = eval_span.stop()
+                    self.log(f"epoch {epoch} evaluation: {results}")
+                    scalars.write(self.step_num, {
+                        "eval/loss": results.loss,
+                        "eval/top1": results.topk_acc[0],
+                        "eval/subtoken_f1": results.subtoken_f1,
+                        "eval/subtoken_precision": results.subtoken_precision,
+                        "eval/subtoken_recall": results.subtoken_recall})
+                    telemetry.event("eval", epoch=epoch, step=self.step_num,
+                                    loss=results.loss,
+                                    subtoken_f1=results.subtoken_f1,
+                                    eval_ms=round(eval_ms, 3))
+                    epoch_end_work = True
+                if epoch_end_work:
+                    # reset the throughput window: checkpoint + eval wall
+                    # time must not be silently absorbed into the next
+                    # epoch's first ex/s figure
+                    window_examples, window_start = 0, time.time()
+            if self._ckpt_writer is not None:
+                # hard commit barrier: training is not done until the last
+                # checkpoint's `state` rename committed (re-raises a
+                # background write failure)
+                self._ckpt_writer.wait()
+        finally:
+            if self._ckpt_writer is not None:
+                # exception-path teardown: drain without
+                # masking the in-flight error (a sticky
+                # write failure still re-raises at the next
+                # submit/wait/close)
+                self._ckpt_writer.drain_quiet()
         profiler.finish(self.params)
         telemetry.close()
         scalars.close()
@@ -601,10 +632,22 @@ class Code2VecModel(Code2VecModelBase):
         return self.predict_prepared(prepared)
 
     # ---- persistence ----
-    def save(self, path: Optional[str] = None) -> None:
+    def _checkpoint_writer(self) -> "ckpt.AsyncCheckpointWriter":
+        if self._ckpt_writer is None:
+            self._ckpt_writer = ckpt.AsyncCheckpointWriter(log=self.log)
+        return self._ckpt_writer
+
+    def save(self, path: Optional[str] = None, block: bool = True) -> None:
         # NOTE: orbax save is a collective — every process must call it
         # (orbax coordinates a single logical writer internally); skipping
-        # non-zero processes would deadlock cross-host saves.
+        # non-zero processes would deadlock cross-host saves. The async
+        # writer preserves this: every process runs its own writer
+        # thread with one-in-flight FIFO discipline, so the collective
+        # sees the same per-process call order as the sync path.
+        #
+        # block=False (the train loop's epoch save) returns once the
+        # snapshot is queued; callers that READ the checkpoint next
+        # (tests, tools, end-of-training) keep the default barrier.
         path = path or self.config.save_path
         assert path
         state = {"params": self.params, "opt_state": self.opt_state,
@@ -624,17 +667,50 @@ class Code2VecModel(Code2VecModelBase):
                  # provenance only (no structural effect on restore)
                  "adv_rename_prob": self.config.ADV_RENAME_PROB,
                  "adv_rename_mode": self.config.ADV_RENAME_MODE}
-        ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
-                             self.dims, extra_manifest=extra,
-                             max_to_keep=self.config.MAX_TO_KEEP)
-        self.log(f"saved checkpoint step {self.step_num} -> {path}")
+        blocked_span = self.telemetry.span("train/save_blocked_ms")
+        if self.config.ASYNC_CHECKPOINT:
+            writer = self._checkpoint_writer()
+            writer.submit(path, state, self.step_num, self.vocabs,
+                          self.dims, extra_manifest=extra,
+                          max_to_keep=self.config.MAX_TO_KEEP,
+                          telemetry=self.telemetry)
+            if block:
+                writer.wait()
+            blocked_ms = blocked_span.stop()
+            self.log(f"queued checkpoint step {self.step_num} -> {path} "
+                     f"(loop blocked {blocked_ms:.1f} ms)")
+        else:
+            ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
+                                 self.dims, extra_manifest=extra,
+                                 max_to_keep=self.config.MAX_TO_KEEP)
+            blocked_ms = blocked_span.stop()
+            # the sync save IS its own writer: total == blocked, and the
+            # commit event keeps telemetry_report's boundary table
+            # mode-agnostic
+            self.telemetry.record_ms("train/save_total_ms", blocked_ms)
+            self.telemetry.event("save_committed", step=self.step_num,
+                                 total_ms=round(blocked_ms, 3))
+            self.log(f"saved checkpoint step {self.step_num} -> {path}")
+        self.telemetry.event("save", step=self.step_num,
+                             blocked_ms=round(blocked_ms, 3),
+                             is_async=bool(self.config.ASYNC_CHECKPOINT))
 
     def release(self) -> None:
         cfg = self.config
         assert cfg.load_path
+        if self._ckpt_writer is not None:
+            # --load-style read of a dir this process may still be
+            # writing: commit barrier first
+            self._ckpt_writer.wait()
         dest = cfg.save_path or (cfg.load_path.rstrip("/") + ".release")
         ckpt.release_checkpoint(cfg.load_path, dest, self.params)
         self.log(f"released inference checkpoint -> {dest}")
+
+    def close_session(self) -> None:
+        # the reference's session-teardown hook doubles as the stop()
+        # commit barrier: no checkpoint may be left half-written
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()
 
     @staticmethod
     def _opt_param_view(params):

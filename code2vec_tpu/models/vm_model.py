@@ -112,6 +112,9 @@ class VarMisuseModel:
             opt_state = shard_opt_state(self.mesh, opt_state, params)
         self.params, self.opt_state = params, opt_state
 
+        # background checkpoint writer (--async_checkpoint, default on);
+        # lazy so load/eval-only instances never start the thread
+        self._ckpt_writer = None
         self._train_step = make_vm_train_step(
             self.dims, self.optimizer, compute_dtype=self.compute_dtype,
             use_pallas=self.use_pallas)
@@ -162,51 +165,75 @@ class VarMisuseModel:
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", log=self.log)
         self.telemetry = telemetry
+        if cfg.ASYNC_CHECKPOINT:
+            # the background writer records save_total_ms from its own
+            # thread into this registry
+            telemetry.make_threadsafe()
         recorder = TrainStepRecorder(
             telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
         steps_into_training = 0
-        from code2vec_tpu.data.prefetch import build_train_infeed
+        from code2vec_tpu.data.prefetch import (build_train_infeed,
+                                                persistent_epochs)
         infeed = build_train_infeed(
             reader, chunk=cfg.INFEED_CHUNK, depth=cfg.INFEED_PREFETCH,
             mesh=self.mesh, host_arrays_fn=self._host_batch_arrays,
             device_batch_fn=self._device_batch, log=self.log)
-        for epoch in range(1, cfg.NUM_TRAIN_EPOCHS + 1):
-            for dev_batch, batch in recorder.wrap(infeed):
-                profiler.tick(steps_into_training, self.params)
-                steps_into_training += 1
-                self.rng, k = jax.random.split(self.rng)
-                self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state, dev_batch, k)
-                self.step_num += 1
-                window += batch.num_valid_examples
-                loss_f = (recorder.end_step(self.step_num, loss,
-                                            batch.num_valid_examples)
-                          if recorder.enabled else None)
-                if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
-                    if loss_f is None:
-                        loss_f = float(loss)
-                    dt = time.time() - t0
-                    self.log(f"vm epoch {epoch} step {self.step_num}: "
-                             f"loss {loss_f:.4f}, "
-                             f"{window / max(dt, 1e-9):.1f} ex/s")
-                    window, t0 = 0, time.time()
-            epoch_end_work = False
-            if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                with telemetry.timed("train/save_ms"):
-                    self.save()
-                epoch_end_work = True
-            if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
-                with telemetry.timed("train/eval_ms"):
+        # one warm producer thread across epoch boundaries (same as
+        # jax_model): epoch k+1 parses/transfers during the boundary
+        # save + eval instead of cold-restarting the double buffer
+        try:
+            for epoch, epoch_batches in persistent_epochs(
+                    infeed, cfg.NUM_TRAIN_EPOCHS):
+                for dev_batch, batch in recorder.wrap(epoch_batches):
+                    profiler.tick(steps_into_training, self.params)
+                    steps_into_training += 1
+                    self.rng, k = jax.random.split(self.rng)
+                    self.params, self.opt_state, loss = self._train_step(
+                        self.params, self.opt_state, dev_batch, k)
+                    self.step_num += 1
+                    window += batch.num_valid_examples
+                    loss_f = (recorder.end_step(self.step_num, loss,
+                                                batch.num_valid_examples)
+                              if recorder.enabled else None)
+                    if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
+                        if loss_f is None:
+                            loss_f = float(loss)
+                        dt = time.time() - t0
+                        self.log(f"vm epoch {epoch} step {self.step_num}: "
+                                 f"loss {loss_f:.4f}, "
+                                 f"{window / max(dt, 1e-9):.1f} ex/s")
+                        window, t0 = 0, time.time()
+                epoch_end_work = False
+                if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                    # async: kick the save first so eval overlaps the
+                    # writer tail (same boundary overlap as jax_model)
+                    self.save(block=False)
+                    epoch_end_work = True
+                if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
+                    eval_span = telemetry.span("train/eval_ms")
                     results = self.evaluate()
-                self.log(f"vm epoch {epoch}: {results}")
-                telemetry.event("eval", epoch=epoch, step=self.step_num,
-                                loss=results.loss,
-                                accuracy=results.accuracy)
-                epoch_end_work = True
-            if epoch_end_work:
-                # checkpoint/eval wall time must not leak into the next
-                # window's first ex/s figure (same fix as jax_model)
-                window, t0 = 0, time.time()
+                    eval_ms = eval_span.stop()
+                    self.log(f"vm epoch {epoch}: {results}")
+                    telemetry.event("eval", epoch=epoch, step=self.step_num,
+                                    loss=results.loss,
+                                    accuracy=results.accuracy,
+                                    eval_ms=round(eval_ms, 3))
+                    epoch_end_work = True
+                if epoch_end_work:
+                    # checkpoint/eval wall time must not leak into the next
+                    # window's first ex/s figure (same fix as jax_model)
+                    window, t0 = 0, time.time()
+            if self._ckpt_writer is not None:
+                # hard commit barrier: end of training (re-raises a
+                # background write failure)
+                self._ckpt_writer.wait()
+        finally:
+            if self._ckpt_writer is not None:
+                # exception-path teardown: drain without
+                # masking the in-flight error (a sticky
+                # write failure still re-raises at the next
+                # submit/wait/close)
+                self._ckpt_writer.drain_quiet()
         profiler.finish(self.params)
         telemetry.close()
         self.log("varmisuse training done")
@@ -269,7 +296,7 @@ class VarMisuseModel:
         _ls, _cs, pred = self._eval_step(self.params, tuple(batch))
         return fetch_global(pred)[:n]
 
-    def save(self, path: Optional[str] = None) -> None:
+    def save(self, path: Optional[str] = None, block: bool = True) -> None:
         path = path or self.config.save_path
         assert path
         state = {"params": self.params, "opt_state": self.opt_state,
@@ -280,11 +307,36 @@ class VarMisuseModel:
                  "trust_ratio": self.config.TRUST_RATIO,
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS}
-        ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
-                             self.dims, extra_manifest=extra,
-                             max_to_keep=self.config.MAX_TO_KEEP)
-        self.log(f"saved varmisuse checkpoint step {self.step_num} "
-                 f"-> {path}")
+        blocked_span = self.telemetry.span("train/save_blocked_ms")
+        if self.config.ASYNC_CHECKPOINT:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = ckpt.AsyncCheckpointWriter(
+                    log=self.log)
+            self._ckpt_writer.submit(
+                path, state, self.step_num, self.vocabs, self.dims,
+                extra_manifest=extra,
+                max_to_keep=self.config.MAX_TO_KEEP,
+                telemetry=self.telemetry)
+            if block:
+                self._ckpt_writer.wait()
+            blocked_ms = blocked_span.stop()
+            self.log(f"queued varmisuse checkpoint step {self.step_num} "
+                     f"-> {path} (loop blocked {blocked_ms:.1f} ms)")
+        else:
+            ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
+                                 self.dims, extra_manifest=extra,
+                                 max_to_keep=self.config.MAX_TO_KEEP)
+            blocked_ms = blocked_span.stop()
+            self.telemetry.record_ms("train/save_total_ms", blocked_ms)
+            self.telemetry.event("save_committed", step=self.step_num,
+                                 total_ms=round(blocked_ms, 3))
+            self.log(f"saved varmisuse checkpoint step {self.step_num} "
+                     f"-> {path}")
+        self.telemetry.event("save", step=self.step_num,
+                             blocked_ms=round(blocked_ms, 3),
+                             is_async=bool(self.config.ASYNC_CHECKPOINT))
 
     def close_session(self) -> None:
-        pass
+        # stop() commit barrier: no checkpoint may be left half-written
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()

@@ -13,6 +13,26 @@ needs no dataset, and `--release` strips optimizer state. Here:
 
 Checkpoints restore with the caller-provided sharding template, so a
 checkpoint written on one mesh reloads onto another (or a single chip).
+
+Async path (`--async_checkpoint`, default on): `AsyncCheckpointWriter`
+makes the train loop's blocked time per checkpoint a small constant —
+`snapshot_state` dispatches on-device copies (the train steps DONATE
+params/opt_state, so by the time a background writer serializes, the
+originals have been invalidated by the next step; a copy decouples the
+snapshot from training for the price of one async device memcpy), and a
+single background thread runs the device fetch + orbax write + pruning.
+One save in flight at a time; a second submit BLOCKS until the first
+commits — never drops or reorders (multi-host: every process runs its
+own writer thread, so the collective orbax save keeps the same
+per-process call order and write discipline as the sync path). The
+torn-write protocol is unchanged: `_step_dirs` counts only step dirs
+with a committed (renamed) `state`, so a writer killed mid-save leaves
+auto-resume pointing at the last COMMITTED step.
+
+Sidecars are write-once per checkpoint dir: vocabularies never change
+within a run, and the manifest only carries structure (its `step` field
+is advisory — `--release` derives the true step from the committed step
+dirs), so epoch saves skip the re-pickle/rewrite when nothing changed.
 """
 
 from __future__ import annotations
@@ -21,7 +41,9 @@ import json
 import os
 import re
 import shutil
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
@@ -47,15 +69,9 @@ def _step_dirs(ckpt_dir: str):
     return sorted(out)
 
 
-def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
-                    vocabs: Code2VecVocabs, dims: ModelDims,
-                    extra_manifest: Optional[Dict[str, Any]] = None,
-                    max_to_keep: int = 10) -> str:
-    os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, f"step_{step}", "state")
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), state, force=True)
-    vocabs.save(os.path.join(ckpt_dir, "vocab.pkl"))
+def _build_manifest(step: int, dims: ModelDims,
+                    extra_manifest: Optional[Dict[str, Any]]
+                    ) -> Dict[str, Any]:
     manifest = {
         "token_vocab_size": dims.token_vocab_size,
         "path_vocab_size": dims.path_vocab_size,
@@ -75,8 +91,59 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
     }
     if extra_manifest:
         manifest.update(extra_manifest)
-    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+    return manifest
+
+
+# ckpt_dir -> weakref to the vocabs object whose pickle THIS process
+# last wrote there: epoch saves with the SAME vocabs skip the re-pickle
+# (vocabularies are immutable within a run), while a different vocabs
+# object aimed at the same dir (a second model trained into a reused
+# directory in one long-lived process) — or a stale sidecar from an
+# earlier run — still gets written. Identity via weakref, not id():
+# a recycled id after GC must not alias a dead object's skip.
+_VOCAB_WRITTEN: Dict[str, Any] = {}
+
+
+def _write_sidecars(ckpt_dir: str, vocabs: Code2VecVocabs,
+                    manifest: Dict[str, Any]) -> None:
+    """vocab.pkl + manifest.json, write-once semantics: skip when present
+    and unchanged. The manifest's `step` field is advisory (readers that
+    need the real step use the committed step dirs — see
+    `load_manifest`), so a step-only difference does not force a
+    rewrite."""
+    import weakref
+
+    vocab_path = os.path.join(ckpt_dir, "vocab.pkl")
+    ref = _VOCAB_WRITTEN.get(ckpt_dir)
+    if (ref is None or ref() is not vocabs
+            or not os.path.exists(vocab_path)):
+        vocabs.save(vocab_path)
+        _VOCAB_WRITTEN[ckpt_dir] = weakref.ref(vocabs)
+    manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    if os.path.exists(manifest_path):
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = None
+        if old is not None and (
+                {k: v for k, v in old.items() if k != "step"}
+                == {k: v for k, v in manifest.items() if k != "step"}):
+            return
+    with open(manifest_path, "w") as f:
         json.dump(manifest, f, indent=1)
+
+
+def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
+                    vocabs: Code2VecVocabs, dims: ModelDims,
+                    extra_manifest: Optional[Dict[str, Any]] = None,
+                    max_to_keep: int = 10) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"step_{step}", "state")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), state, force=True)
+    _write_sidecars(ckpt_dir, vocabs,
+                    _build_manifest(step, dims, extra_manifest))
     # Retention: keep the newest `max_to_keep` step dirs (reference
     # MAX_TO_KEEP=10 semantics).
     steps = _step_dirs(ckpt_dir)
@@ -85,14 +152,170 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
     return path
 
 
+def snapshot_state(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Decouple a state pytree from the train loop: async-dispatched
+    on-device copies of every jax.Array leaf. The train steps donate
+    their params/opt_state buffers, so handing the ORIGINALS to a
+    background writer would read deleted arrays as soon as the next step
+    dispatches; the copy costs one device memcpy (dispatch returns
+    immediately — the loop does not wait for the bytes) plus transient
+    HBM for the duplicate until the writer drains. Non-array leaves
+    (the python `step` int) pass through untouched so the saved
+    structure is identical to the sync path's."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state)
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writer: the Check-N-Run / t5x
+    AsyncCheckpointer shape. `submit()` returns as soon as the snapshot
+    is queued; one daemon thread runs the device fetch + serialization +
+    committed-`state` rename + retention pruning. Discipline:
+
+      - ONE save in flight: a second `submit` while the first is still
+        writing blocks until it commits (never drops, never reorders —
+        the orbax collective needs every process to issue the same save
+        sequence).
+      - `wait()` is the hard commit barrier (end of training, explicit
+        `save(block=True)`, anything about to READ the checkpoint dir).
+      - a failed background save is sticky: the error re-raises at the
+        next `submit`/`wait`/`close` instead of letting a run train for
+        hours past a dead disk.
+
+    `save_fn` is injectable for crash-safety tests (simulate a writer
+    killed before the `state` rename commits)."""
+
+    def __init__(self, log: Optional[Callable[[str], None]] = None,
+                 save_fn: Optional[Callable] = None):
+        self._log = log or (lambda _m: None)
+        # None -> module-level save_checkpoint, resolved at WRITE time
+        # (tests monkeypatch the module function to inject slow disks
+        # and torn writes)
+        self._save_fn = save_fn
+        self._cond = threading.Condition()
+        self._job: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _raise_pending(self) -> None:
+        # threading.Condition's default lock is an RLock, so this is
+        # safe from call sites already holding _cond
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def submit(self, ckpt_dir: str, state: Dict[str, Any], step: int,
+               vocabs: Code2VecVocabs, dims: ModelDims, *,
+               extra_manifest: Optional[Dict[str, Any]] = None,
+               max_to_keep: int = 10, telemetry=None) -> None:
+        """Snapshot `state` and queue the save. Blocks only on the
+        snapshot dispatch — unless a previous save is still in flight,
+        in which case it blocks until that one commits."""
+        snap = snapshot_state(state)
+        with self._cond:
+            self._raise_pending()
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            while self._job is not None:
+                self._cond.wait()
+                self._raise_pending()
+            self._job = {
+                "ckpt_dir": ckpt_dir, "state": snap, "step": step,
+                "vocabs": vocabs, "dims": dims,
+                "extra_manifest": extra_manifest,
+                "max_to_keep": max_to_keep, "telemetry": telemetry,
+                "t_submit": time.perf_counter(),
+            }
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="ckpt-writer")
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._job is None and not self._closed:
+                    self._cond.wait()
+                if self._job is None:
+                    return  # closed and drained
+                job = self._job
+            try:
+                t0 = time.perf_counter()
+                save_fn = self._save_fn or save_checkpoint
+                save_fn(job["ckpt_dir"], job["state"], job["step"],
+                        job["vocabs"], job["dims"],
+                        extra_manifest=job["extra_manifest"],
+                        max_to_keep=job["max_to_keep"])
+                total_ms = (time.perf_counter() - t0) * 1e3
+                tele = job["telemetry"]
+                if tele is not None:
+                    tele.record_ms("train/save_total_ms", total_ms)
+                    tele.event("save_committed", step=int(job["step"]),
+                               total_ms=round(total_ms, 3))
+                self._log(f"async checkpoint step {job['step']} "
+                          f"committed -> {job['ckpt_dir']} "
+                          f"({total_ms:.0f} ms in background)")
+            except BaseException as e:  # surfaces at next submit/wait
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._job = None
+                    self._cond.notify_all()
+
+    def wait(self) -> None:
+        """Hard commit barrier: returns once no save is in flight;
+        re-raises a background failure."""
+        with self._cond:
+            while self._job is not None:
+                self._cond.wait()
+            self._raise_pending()
+
+    def drain_quiet(self) -> None:
+        """Barrier without the re-raise (exception-path teardown: the
+        original error must not be masked; a sticky writer error still
+        surfaces at the next wait/submit/close)."""
+        with self._cond:
+            while self._job is not None:
+                self._cond.wait()
+
+    def close(self) -> None:
+        """Commit barrier + writer-thread shutdown."""
+        with self._cond:
+            while self._job is not None:
+                self._cond.wait()
+            self._closed = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        with self._cond:
+            self._raise_pending()
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
     steps = _step_dirs(ckpt_dir)
     return steps[-1][0] if steps else None
 
 
 def load_manifest(ckpt_dir: str) -> Dict[str, Any]:
+    """Manifest with the EFFECTIVE step: the on-disk `step` field is
+    advisory (sidecars are write-once — it freezes at the dir's first
+    save), so every consumer that needs the real step — the released
+    checkpoint's step, the LR-schedule resume horizon in
+    models/setup.py — gets it corrected here from the committed step
+    dirs."""
     with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-        return json.load(f)
+        manifest = json.load(f)
+    step = latest_step(ckpt_dir)
+    if step is not None:
+        manifest["step"] = step
+    return manifest
 
 
 def load_dims(ckpt_dir: str) -> ModelDims:
@@ -139,7 +362,7 @@ def release_checkpoint(load_dir: str, dest_dir: str,
     """Reference `--release` (SURVEY.md §4.5): write a stripped
     inference-only checkpoint (params, no optimizer slots)."""
     os.makedirs(dest_dir, exist_ok=True)
-    manifest = load_manifest(load_dir)
+    manifest = load_manifest(load_dir)  # step already effective
     manifest["released"] = True
     step = manifest.get("step", 0)
     path = os.path.join(dest_dir, f"step_{step}", "state")

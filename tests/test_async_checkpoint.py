@@ -1,0 +1,339 @@
+"""Async checkpointing (ISSUE 5): zero-stall epoch boundaries.
+
+Covers the acceptance contract on the CPU mesh harness:
+  - the train loop emits `save_blocked_ms` << `save_total_ms` with
+    async on, and training steps demonstrably proceed while the writer
+    drains;
+  - `--async_checkpoint off` reproduces the synchronous checkpoint
+    directory layout bit-for-bit (same file tree, same restored
+    values);
+  - crash safety: a writer killed before the `state` rename commits
+    leaves auto-resume pointing at the last COMMITTED step (the
+    torn-write protocol survives the async path);
+  - mid-train save -> restore parity: the snapshot is the exact params
+    at save time, unpolluted by the donated-buffer updates that race
+    the background writer;
+  - sidecar write-once semantics keep `--release` correct;
+  - tools/telemetry_report.py renders the epoch-boundary table from
+    the new save / save_committed / eval events.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.jax_model import Code2VecModel
+from code2vec_tpu.training import checkpoint as ckpt
+from tests.helpers import build_tiny_dataset
+from tests.test_model import tiny_config
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    return build_tiny_dataset(str(d), n_train=256, n_val=32, n_test=64,
+                              max_contexts=16)
+
+
+def _read_events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl"),
+              encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def _tree_leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(x)),
+                                      np.asarray(jax.device_get(y)))
+
+
+def test_async_blocked_far_below_total_and_steps_overlap(
+        dataset, tmp_path, monkeypatch):
+    """The CI acceptance assertion: with async on, the loop-side
+    blocked time per checkpoint is a small constant while the writer
+    wall carries the real save cost, and next-epoch step events land
+    INSIDE the save window (training proceeded while the writer wrote).
+    A 300 ms simulated disk tail makes the ratio deterministic on any
+    CI machine."""
+    real_save = ckpt.save_checkpoint
+
+    def slow_save(*a, **k):
+        time.sleep(0.3)
+        return real_save(*a, **k)
+
+    monkeypatch.setattr(ckpt, "save_checkpoint", slow_save)
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_EPOCHS=1,
+                      save_path=str(tmp_path / "ckpt"),
+                      TELEMETRY_DIR=str(tmp_path / "tele"))
+    cfg.test_data_path = None  # no eval: epoch-2 steps fill the drain
+    assert cfg.ASYNC_CHECKPOINT  # the default
+    model = Code2VecModel(cfg)
+    # warm the snapshot's copy-kernel compiles: the FIRST jnp.copy per
+    # shape pays a one-time eager-dispatch compile (~hundreds of ms on
+    # CPU) that would otherwise land in save #1's blocked time and
+    # measure XLA, not the checkpoint protocol
+    ckpt.snapshot_state({"params": model.params,
+                         "opt_state": model.opt_state, "step": 0})
+    model.train()
+    model.close_session()
+
+    events = _read_events(model.telemetry.run_dir)
+    saves = {e["step"]: e for e in events if e["kind"] == "save"}
+    commits = {e["step"]: e for e in events
+               if e["kind"] == "save_committed"}
+    assert len(saves) == 2 and len(commits) == 2
+    first_step = min(saves)
+    blocked = saves[first_step]["blocked_ms"]
+    total = commits[first_step]["total_ms"]
+    assert total >= 300.0  # the simulated tail is in the writer wall
+    assert blocked < 0.25 * total, (
+        f"loop blocked {blocked} ms vs writer wall {total} ms")
+    # steps whose event fired inside the first save's window: the loop
+    # was training while the writer drained
+    window = (saves[first_step]["ts"], commits[first_step]["ts"])
+    during = [e for e in events if e["kind"] == "step"
+              and window[0] <= e["ts"] <= window[1]]
+    assert during, "no training steps ran while the writer drained"
+    # both epochs' checkpoints committed despite the slow writer
+    assert ckpt.latest_step(cfg.save_path) == model.step_num
+
+
+def test_sync_flag_reproduces_checkpoint_layout(dataset, tmp_path):
+    """--async_checkpoint off must be today's synchronous save — and
+    the async dir must be indistinguishable from it: identical file
+    tree, identical manifest, identical restored values (same seed and
+    data give the same trajectory)."""
+    def run(use_async, tag):
+        cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=2,
+                          SAVE_EVERY_EPOCHS=1,
+                          ASYNC_CHECKPOINT=use_async,
+                          save_path=str(tmp_path / tag))
+        cfg.test_data_path = None
+        model = Code2VecModel(cfg)
+        model.train()
+        model.close_session()
+        return model
+
+    m_async = run(True, "a")
+    m_sync = run(False, "s")
+
+    def layout(root):
+        """The checkpoint-protocol layout: every file/dir relative
+        path, pruned INSIDE the orbax `state` trees (ocdbt names its
+        data blobs with unique ids, so their filenames legitimately
+        differ run to run — the protocol contract is the step dirs,
+        the committed `state` marker, and the sidecars)."""
+        out = set()
+        for base, dirs, files in os.walk(root):
+            rel = os.path.relpath(base, root)
+            if "state" in dirs:
+                out.add(os.path.normpath(os.path.join(rel, "state")))
+                dirs.remove("state")
+            for f in files:
+                out.add(os.path.normpath(os.path.join(rel, f)))
+        return out
+
+    sync_layout = layout(str(tmp_path / "s"))
+    assert layout(str(tmp_path / "a")) == sync_layout
+    # and that layout is exactly the documented protocol shape
+    steps_per_epoch = m_sync.step_num // 2
+    assert {p for p in sync_layout if "state" in p} == {
+        os.path.join(f"step_{steps_per_epoch * e}", "state")
+        for e in (1, 2)}
+    assert {p for p in sync_layout if os.sep not in p} == {
+        "manifest.json", "vocab.pkl"}
+    assert (ckpt.latest_step(str(tmp_path / "a"))
+            == ckpt.latest_step(str(tmp_path / "s")))
+    assert (ckpt.load_manifest(str(tmp_path / "a"))
+            == ckpt.load_manifest(str(tmp_path / "s")))
+    _tree_leaves_equal(m_async.params, m_sync.params)
+    # restored values agree too (the async snapshot wrote the same
+    # bytes the sync save did)
+    template = {"params": m_sync.params, "opt_state": m_sync.opt_state,
+                "step": 0}
+    a = ckpt.load_checkpoint(str(tmp_path / "a"), template)
+    s = ckpt.load_checkpoint(str(tmp_path / "s"), template)
+    _tree_leaves_equal(a, s)
+
+
+def test_writer_crash_before_commit_preserves_resume(dataset, tmp_path):
+    """Kill the writer before the `state` rename: the torn step dir is
+    invisible to latest_step, auto-resume restores the last COMMITTED
+    step, and the failure surfaces at the barrier instead of vanishing."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=1, SAVE_EVERY_EPOCHS=1,
+                      save_path=ckpt_dir)
+    cfg.test_data_path = None
+    model = Code2VecModel(cfg)
+    model.train()
+    model.close_session()
+    committed = ckpt.latest_step(ckpt_dir)
+    assert committed == model.step_num
+
+    def killed_mid_save(ckpt_dir, state, step, vocabs, dims, **kw):
+        # what a preemption mid-orbax-write leaves behind: a step dir
+        # with temp content but NO renamed `state`
+        os.makedirs(os.path.join(ckpt_dir, f"step_{step}",
+                                 "state.orbax-checkpoint-tmp"),
+                    exist_ok=True)
+        raise RuntimeError("writer killed before commit")
+
+    writer = ckpt.AsyncCheckpointWriter(save_fn=killed_mid_save)
+    state = {"params": model.params, "opt_state": model.opt_state,
+             "step": model.step_num + 5}
+    writer.submit(ckpt_dir, state, model.step_num + 5, model.vocabs,
+                  model.dims)
+    with pytest.raises(RuntimeError, match="killed before commit"):
+        writer.wait()
+    writer.close()
+
+    # the torn dir exists but is invisible to resume
+    assert os.path.isdir(os.path.join(
+        ckpt_dir, f"step_{model.step_num + 5}"))
+    assert ckpt.latest_step(ckpt_dir) == committed
+
+    # auto-resume semantics: a fresh model loading this dir restores
+    # the committed step
+    cfg2 = tiny_config(dataset)
+    cfg2.load_path = ckpt_dir
+    model2 = Code2VecModel(cfg2)
+    assert model2.step_num == committed
+    _tree_leaves_equal(model2.params, model.params)
+
+
+def test_mid_train_async_save_restore_parity(dataset, tmp_path):
+    """The epoch-1 checkpoint of a 2-epoch async run must be the EXACT
+    params a 1-epoch run ends with (same seed/data => same trajectory):
+    the on-device snapshot is immune to the donated-buffer updates the
+    next epoch makes while the writer is still draining. Constant LR:
+    the cosine schedule's horizon depends on NUM_TRAIN_EPOCHS, which
+    would legitimately diverge the two trajectories."""
+    def run(epochs, tag):
+        cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=epochs,
+                          SAVE_EVERY_EPOCHS=1, LR_SCHEDULE="constant",
+                          save_path=str(tmp_path / tag))
+        cfg.test_data_path = None
+        model = Code2VecModel(cfg)
+        model.train()
+        model.close_session()
+        return model
+
+    m1 = run(1, "one")
+    m2 = run(2, "two")
+    steps_per_epoch = m1.step_num
+    assert m2.step_num == 2 * steps_per_epoch
+    # both epoch checkpoints committed in the 2-epoch run
+    template = {"params": m2.params, "opt_state": m2.opt_state,
+                "step": 0}
+    mid = ckpt.load_checkpoint(str(tmp_path / "two"), template,
+                               step=steps_per_epoch)
+    assert int(jax.device_get(mid["step"])) == steps_per_epoch
+    _tree_leaves_equal(mid["params"], m1.params)
+    # and the final checkpoint is the final params
+    final = ckpt.load_checkpoint(str(tmp_path / "two"), template)
+    _tree_leaves_equal(final["params"], m2.params)
+
+
+def test_sidecars_written_once_and_release_step_correct(
+        dataset, tmp_path, monkeypatch):
+    """Satellite: epoch saves must not re-pickle vocab.pkl / rewrite an
+    unchanged manifest.json, and --release must still pick the REAL
+    latest step (the manifest's `step` is advisory now)."""
+    from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
+    calls = []
+    real_save = Code2VecVocabs.save
+
+    def counting_save(self, path):
+        calls.append(path)
+        return real_save(self, path)
+
+    monkeypatch.setattr(Code2VecVocabs, "save", counting_save)
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = tiny_config(dataset, NUM_TRAIN_EPOCHS=3, SAVE_EVERY_EPOCHS=1,
+                      save_path=ckpt_dir)
+    cfg.test_data_path = None
+    model = Code2VecModel(cfg)
+    model.train()
+    model.close_session()
+    assert len([c for c in calls if c.startswith(ckpt_dir)]) == 1, (
+        f"vocab.pkl re-pickled: {calls}")
+    # the ON-DISK manifest step is the FIRST save's (write-once,
+    # advisory) while load_manifest corrects it to the latest
+    # COMMITTED step for every consumer (release, LR resume horizon)
+    steps = sorted(s for s, _ in ckpt._step_dirs(ckpt_dir))
+    assert len(steps) == 3
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        assert json.load(f)["step"] == steps[0]
+    assert ckpt.load_manifest(ckpt_dir)["step"] == steps[-1]
+
+    # release resolves the latest committed step, not the stale field
+    dest = str(tmp_path / "released")
+    ckpt.release_checkpoint(ckpt_dir, dest, model.params)
+    rel_manifest = ckpt.load_manifest(dest)
+    assert rel_manifest["step"] == steps[-1] == model.step_num
+    assert rel_manifest["released"] is True
+    # and a released-checkpoint load restores that step
+    cfg2 = tiny_config(dataset)
+    cfg2.train_data_path = None
+    cfg2.load_path = dest
+    model_rel = Code2VecModel(cfg2)
+    assert model_rel.step_num == model.step_num
+
+
+def test_second_submit_blocks_never_drops(tmp_path):
+    """One-in-flight discipline: submit #2 waits for save #1's commit;
+    both land."""
+    order = []
+
+    def slow_save(ckpt_dir, state, step, vocabs, dims, **kw):
+        time.sleep(0.15)
+        order.append(step)
+
+    writer = ckpt.AsyncCheckpointWriter(save_fn=slow_save)
+    writer.submit("d", {}, 1, None, None)
+    t0 = time.perf_counter()
+    writer.submit("d", {}, 2, None, None)
+    waited = time.perf_counter() - t0
+    writer.wait()
+    writer.close()
+    assert order == [1, 2]
+    assert waited >= 0.05  # submit #2 really blocked on save #1
+
+
+def test_telemetry_report_renders_boundary_table(tmp_path):
+    """Satellite: the epoch-boundary row (save_blocked_ms /
+    save_total_ms / eval_ms / overlap) renders from the new events."""
+    from tools.telemetry_report import boundary_rows, render
+    run_dir = tmp_path / "run-x"
+    run_dir.mkdir()
+    (run_dir / "manifest.json").write_text(json.dumps(
+        {"run_id": "run-x", "component": "train"}))
+    events = [
+        {"kind": "save", "ts": 10.0, "step": 8, "blocked_ms": 5.0,
+         "is_async": True},
+        {"kind": "save_committed", "ts": 10.2, "step": 8,
+         "total_ms": 200.0},
+        {"kind": "eval", "ts": 10.15, "step": 8, "epoch": 1,
+         "loss": 1.0, "eval_ms": 120.0},
+    ]
+    with open(run_dir / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rows = boundary_rows(events)
+    assert rows == [{"step": 8, "blocked_ms": 5.0, "total_ms": 200.0,
+                     "eval_ms": 120.0, "overlap": 1.0 - 5.0 / 200.0,
+                     "is_async": True}]
+    out = render([str(run_dir)])
+    assert "Epoch boundary" in out
+    assert "| 8 | async | 5.00 | 200.00 | 120.00 | 0.975 |" in out

@@ -1,0 +1,52 @@
+"""tools/epoch_overhead.py — the ISSUE 5 boundary-stall driver.
+
+Tier-1 covers the event analysis (boundary wall, steps-during-save
+window) on synthetic events; the end-to-end sync-vs-async measurement
+run is `slow`-marked (it trains two models), same policy as
+requant_sweep / loadgen.
+"""
+
+import json
+
+import pytest
+
+from tools.epoch_overhead import analyze, main
+
+
+def test_analyze_boundary_and_overlap_windows():
+    events = [
+        {"kind": "step", "ts": 1.00, "step": 7, "step_ms": 10.0},
+        {"kind": "step", "ts": 1.01, "step": 8, "step_ms": 10.0},
+        {"kind": "save", "ts": 1.02, "step": 8, "blocked_ms": 5.0,
+         "is_async": True},
+        {"kind": "step", "ts": 1.05, "step": 9, "step_ms": 10.0},
+        {"kind": "step", "ts": 1.10, "step": 10, "step_ms": 10.0},
+        {"kind": "save_committed", "ts": 1.12, "step": 8,
+         "total_ms": 100.0},
+        {"kind": "step", "ts": 1.20, "step": 11, "step_ms": 10.0},
+    ]
+    rows = analyze(events)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["step"] == 8
+    assert r["blocked_ms"] == 5.0 and r["total_ms"] == 100.0
+    # boundary: last step event at/below the save step -> first after
+    assert r["boundary_ms"] == pytest.approx((1.05 - 1.01) * 1e3, abs=0.2)
+    # steps 9 and 10 fired inside [save.ts, commit.ts]
+    assert r["steps_during_save"] == 2
+
+
+@pytest.mark.slow
+def test_epoch_overhead_cli_end_to_end(capsys):
+    """Small but real sync-vs-async comparison on the CPU harness: the
+    acceptance numbers come from this driver at default scale."""
+    result = main(["--epochs", "3", "--examples", "128", "--batch", "32",
+                   "--emb", "16", "--warmup_boundaries", "2"])
+    assert len(result["sync"]) == 3 and len(result["async"]) == 3
+    s = result["summary"]
+    assert s["sync_save_wall_ms_p50"] > 0
+    assert s["async_blocked_ms_p50"] == s["async_blocked_ms_p50"]  # not nan
+    # every boundary row is printed as a JSON line
+    out = capsys.readouterr().out
+    assert sum(1 for ln in out.splitlines()
+               if ln.startswith("{") and "mode" in json.loads(ln)) == 6

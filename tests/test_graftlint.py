@@ -45,7 +45,8 @@ def test_repo_lints_clean_against_baseline(repo_findings):
 
 
 def test_serving_and_obs_trees_are_finding_free(repo_findings):
-    """ISSUE 4 acceptance: EMPTY baseline for serving/ and obs/ — and
+    """ISSUE 4 acceptance (extended to training/ with the async
+    checkpoint writer): EMPTY baseline for the no-baseline trees — and
     not just baselined-away: zero findings at all."""
     dirty = [f for f in repo_findings
              if f.path.startswith(baseline_mod.NO_BASELINE_PREFIXES)]
@@ -183,9 +184,12 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     path = str(tmp_path / "base.json")
     bad = Finding("lock-discipline", "code2vec_tpu/serving/batcher.py",
                   1, "m", "s")
+    bad_training = Finding("lock-discipline",
+                           "code2vec_tpu/training/checkpoint.py",
+                           1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
-    refused = baseline_mod.write([bad, ok], path)
-    assert refused == [bad]
+    refused = baseline_mod.write([bad, bad_training, ok], path)
+    assert refused == [bad, bad_training]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 

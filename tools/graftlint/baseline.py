@@ -14,10 +14,12 @@ Workflow:
     entries so the baseline only ever shrinks (`--write-baseline`
     drops them).
 
-Policy (ISSUE 4): the baseline must stay EMPTY for
-`code2vec_tpu/serving/` and `code2vec_tpu/obs/` — the threaded serving
-layer and the telemetry registry are exactly where these hazard classes
-are bugs, not debt. tests/test_graftlint.py enforces that.
+Policy (ISSUE 4, extended by ISSUE 5): the baseline must stay EMPTY
+for `code2vec_tpu/serving/`, `code2vec_tpu/obs/` and
+`code2vec_tpu/training/` — the threaded serving layer, the telemetry
+registry and the training subsystem (which now hosts the async
+checkpoint writer thread) are exactly where these hazard classes are
+bugs, not debt. tests/test_graftlint.py enforces that.
 """
 
 from __future__ import annotations
@@ -30,8 +32,10 @@ from tools.graftlint.core import Finding, REPO_ROOT
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
 
-# baselining is forbidden under these trees (ISSUE 4 acceptance)
-NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/")
+# baselining is forbidden under these trees (ISSUE 4 acceptance;
+# training/ added with the async checkpoint writer — ISSUE 5)
+NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/",
+                        "code2vec_tpu/training/")
 
 
 def _entry(f: Finding) -> Dict[str, str]:

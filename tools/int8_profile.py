@@ -28,6 +28,69 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _build_grad_fn(loss_fn, params0, quantized):
+    """Jitted fwd+bwd for one tables_dtype — a factory so the jit is
+    evaluated ONCE per dtype, outside the measurement loops (the
+    graftlint retrace-hazard fix: the old inline construction rebuilt a
+    fresh callable with an empty compile cache inside `main`'s dtype
+    loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.ops.quant import is_quantized
+
+    if not quantized:
+        return jax.jit(jax.value_and_grad(loss_fn))
+    qkeys = sorted(k for k in params0 if is_quantized(params0[k]))
+
+    @jax.jit
+    def grad_fn(params, batch, rng):
+        def lf(carriers, params):
+            virt = dict(params)
+            for k, c in carriers.items():
+                virt[k] = dict(params[k], g=c)
+            return loss_fn(virt, batch, rng)
+        carriers = {k: jnp.zeros(params[k]["q"].shape,
+                                 jnp.bfloat16) for k in qkeys}
+        return jax.value_and_grad(
+            lf, argnums=(0, 1), allow_int=True)(carriers, params)
+
+    return grad_fn
+
+
+def _build_apply_step(optimizer, flat_grads):
+    """Jitted optimizer.update + apply on precomputed grads (same
+    factory-per-dtype reasoning as `_build_grad_fn`)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from code2vec_tpu.ops.quant import is_quantized, requantize
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def apply_step(params, opt_state, rng):
+        qkeys_l = sorted(k for k in params if is_quantized(params[k]))
+        rng, *qrngs = jax.random.split(rng, 1 + len(qkeys_l))
+        flat_params = {k: (jnp.zeros(params[k]["q"].shape,
+                                     jnp.bfloat16)
+                           if is_quantized(params[k]) else params[k])
+                       for k in params}
+        updates, opt_state = optimizer.update(flat_grads, opt_state,
+                                              flat_params)
+        new_params = {}
+        for k, qrng in zip(qkeys_l, qrngs):
+            new_params[k] = requantize(params[k], updates[k], qrng)
+        for k in params:
+            if k not in new_params:
+                new_params[k] = optax.apply_updates(params[k],
+                                                    updates[k])
+        return new_params, opt_state, rng
+
+    return apply_step
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -36,15 +99,11 @@ def main() -> None:
     from tools._bench_common import load_bench_module
     bench = load_bench_module()
 
-    import functools
-
     import jax
     import jax.numpy as jnp
-    import optax
 
     from code2vec_tpu.models.encoder import init_params
-    from code2vec_tpu.ops.quant import (is_quantized, opt_param_view,
-                                        requantize)
+    from code2vec_tpu.ops.quant import is_quantized, opt_param_view
     from code2vec_tpu.training.optimizers import make_optimizer
     from code2vec_tpu.training.steps import make_train_loss_fn
 
@@ -61,23 +120,7 @@ def main() -> None:
         quantized = tdtype == "int8"
 
         # ---- fwd+bwd ----
-        if quantized:
-            qkeys = sorted(k for k in params0
-                           if is_quantized(params0[k]))
-
-            @jax.jit
-            def grad_fn(params, batch, rng):
-                def lf(carriers, params):
-                    virt = dict(params)
-                    for k, c in carriers.items():
-                        virt[k] = dict(params[k], g=c)
-                    return loss_fn(virt, batch, rng)
-                carriers = {k: jnp.zeros(params[k]["q"].shape,
-                                         jnp.bfloat16) for k in qkeys}
-                return jax.value_and_grad(
-                    lf, argnums=(0, 1), allow_int=True)(carriers, params)
-        else:
-            grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        grad_fn = _build_grad_fn(loss_fn, params0, quantized)
 
         def chain_fb(n, rng, _params=params0, _grad_fn=grad_fn):
             rng, sub = jax.random.split(rng)
@@ -101,27 +144,9 @@ def main() -> None:
                           else jnp.full(params0[k].shape, 1e-3,
                                         params0[k].dtype))
                       for k in params0}
+        apply_step = _build_apply_step(optimizer, flat_grads)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def apply_step(params, opt_state, rng):
-            qkeys_l = sorted(k for k in params if is_quantized(params[k]))
-            rng, *qrngs = jax.random.split(rng, 1 + len(qkeys_l))
-            flat_params = {k: (jnp.zeros(params[k]["q"].shape,
-                                         jnp.bfloat16)
-                               if is_quantized(params[k]) else params[k])
-                           for k in params}
-            updates, opt_state = optimizer.update(flat_grads, opt_state,
-                                                  flat_params)
-            new_params = {}
-            for k, qrng in zip(qkeys_l, qrngs):
-                new_params[k] = requantize(params[k], updates[k], qrng)
-            for k in params:
-                if k not in new_params:
-                    new_params[k] = optax.apply_updates(params[k],
-                                                        updates[k])
-            return new_params, opt_state, rng
-
-        def chain_opt(n, state):
+        def chain_opt(n, state, apply_step=apply_step):
             params, opt_state, rng = state
             t0 = time.perf_counter()
             for _ in range(n):

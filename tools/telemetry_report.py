@@ -14,7 +14,9 @@ Given `--telemetry_dir`'s root (or one run directory), prints
     run_id as the Source column;
   - per-run detail tables: every timer histogram (count / mean /
     p50 / p95 / p99 / max), serving request percentiles, final loss,
-    gauges, and any bench/profile events the run carried.
+    gauges, an epoch-boundary table (save_blocked_ms / save_total_ms /
+    eval_ms / save overlap ratio, from the save / save_committed / eval
+    events), and any bench/profile events the run carried.
 
 Pure stdlib + the repo's own modules; reads only the manifest + events
 files, so it works on a laptop over a run dir scp'd from a pod.
@@ -154,6 +156,42 @@ def _timer_rows(events: List[Dict[str, Any]]) -> Dict[str, Dict]:
     return out
 
 
+def boundary_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Epoch-boundary rows from the checkpoint/eval events: one row per
+    `save` event (kind="save": loop-side blocked_ms), joined with its
+    `save_committed` (writer-side total_ms) by step and the epoch's
+    `eval` event (eval_ms). `overlap` is the fraction of the save wall
+    HIDDEN from the train loop: 1 - blocked/total (a synchronous save
+    scores 0, a fully-backgrounded one approaches 1)."""
+    commits: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") == "save_committed" and "step" in e:
+            commits[int(e["step"])] = e
+    evals: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") == "eval" and "step" in e:
+            evals[int(e["step"])] = e
+    rows = []
+    for e in events:
+        if e.get("kind") != "save" or "step" not in e:
+            continue
+        step = int(e["step"])
+        blocked = float(e.get("blocked_ms", float("nan")))
+        commit = commits.get(step)
+        total = (float(commit["total_ms"])
+                 if commit and "total_ms" in commit else float("nan"))
+        ev = evals.get(step)
+        eval_ms = (float(ev["eval_ms"])
+                   if ev and "eval_ms" in ev else None)
+        overlap = (1.0 - blocked / total
+                   if total == total and total > 0 else float("nan"))
+        rows.append({"step": step, "blocked_ms": blocked,
+                     "total_ms": total, "eval_ms": eval_ms,
+                     "overlap": overlap,
+                     "is_async": bool(e.get("is_async", False))})
+    return rows
+
+
 def _fmt(v, nd: int = 2) -> str:
     if v is None:
         return "—"
@@ -225,6 +263,22 @@ def render(run_dirs: List[str]) -> str:
             lines.append("")
             lines.append("gauges: " + ", ".join(
                 f"{k}={_fmt(v, 1)}" for k, v in sorted(gauges.items())))
+        # ---- epoch boundaries: save blocked vs total, eval, overlap ----
+        b_rows = boundary_rows(events)
+        if b_rows:
+            lines.append("")
+            lines.append("| Epoch boundary (step) | mode "
+                         "| save_blocked_ms | save_total_ms | eval_ms "
+                         "| save overlap |")
+            lines.append("|---|---|---|---|---|---|")
+            for r in b_rows:
+                lines.append(
+                    f"| {r['step']} "
+                    f"| {'async' if r['is_async'] else 'sync'} "
+                    f"| {_fmt(r['blocked_ms'])} "
+                    f"| {_fmt(r['total_ms'])} "
+                    f"| {_fmt(r['eval_ms'])} "
+                    f"| {_fmt(r['overlap'], 3)} |")
         bench_events = [e for e in events if e.get("kind") == "bench"]
         for b in bench_events:
             lines.append("")

@@ -171,13 +171,13 @@ def main() -> None:
     head_flops = 2 * B * (NUM_SAMPLED + 1) * D
     rng = jax.random.PRNGKey(1)
     on_tpu = jax.default_backend() == "tpu"
-    fb = full = None
-    # both attention paths: XLA einsum+softmax vs the fused Pallas
-    # kernel pair (ops/xf_attention.py) — the before/after of the
-    # [B,H,C,C] HBM materialization
-    for tag, use_pallas in (("xla", False), ("pallas", on_tpu)):
-        if tag == "pallas" and not on_tpu:
-            break  # interpret mode would measure the interpreter
+
+    def measure_variant(tag, use_pallas):
+        """Build + time one attention path's loss/grad/step. A factory
+        so each variant's jits are evaluated once, outside the tag loop
+        (graftlint retrace-hazard burndown: the two variants need
+        genuinely different callables — use_pallas changes the program
+        — so per-variant construction is the honest structure)."""
         loss_fn = make_train_loss_fn(dims, use_sampled_softmax=True,
                                      num_sampled=NUM_SAMPLED,
                                      compute_dtype=jnp.bfloat16,
@@ -218,6 +218,15 @@ def main() -> None:
         full = rec(f"full_step_adafactor_{tag}", dt,
                    flops=3 * (enc_flops + head_flops),
                    extra={"pc_per_sec": round(B * CTX / dt, 1)})
+        return fb, full
+
+    # both attention paths: XLA einsum+softmax vs the fused Pallas
+    # kernel pair (ops/xf_attention.py) — the before/after of the
+    # [B,H,C,C] HBM materialization. Off-TPU only XLA runs (interpret
+    # mode would measure the interpreter).
+    fb, full = measure_variant("xla", False)
+    if on_tpu:
+        fb, full = measure_variant("pallas", True)
 
     # ---- roofline statement ----
     util = (full["tflops_per_sec"]
