@@ -268,6 +268,30 @@ class Config:
     # the non-intrusive tool).
     TELEMETRY_DIR: Optional[str] = None
 
+    # ---- request-scoped tracing + stall watchdog (code2vec_tpu/obs/
+    # trace.py + watchdog.py, ISSUE 6; both need --telemetry_dir — the
+    # spans and stall dumps live in the run dir). ----
+    # --trace: per-request span trees (queue -> batch -> device ->
+    # decode share one trace id through the serving threads) and
+    # per-step span trees (infeed_wait / step, linking the infeed batch
+    # consumed and the async save triggered). Export with
+    # tools/trace_report.py (--chrome for Perfetto / chrome://tracing).
+    # Off (default): one boolean check on every traced path.
+    TRACE: bool = False
+    # --watchdog_stall_s: per-component progress deadline in seconds
+    # for the heartbeating components (train loop, infeed producer,
+    # checkpoint writer, serving batcher). A missed deadline emits a
+    # `stall` telemetry event and dumps live spans + all thread stacks
+    # + a registry snapshot to the run dir. 0 (default) = off. Size it
+    # above the slowest legitimate gap (first-step jit compile, epoch
+    # eval).
+    WATCHDOG_STALL_S: float = 0.0
+    # --watchdog_mode: "warn" records the stall and keeps running;
+    # "raise" additionally makes it sticky — StallError at the stalled
+    # component's next beat / the end-of-run poll (loud death over a
+    # silent wedge).
+    WATCHDOG_MODE: str = "warn"
+
     # ---- adversarial attacks (the noamyft fork delta, SURVEY.md §0
     # item 2; attacks/): --attack {targeted,untargeted} runs the
     # gradient-guided rename attack on --attack_input's source and
@@ -479,6 +503,24 @@ class Config:
                             "infeed_wait_ms / loss, device-memory "
                             "gauges, serving latency); summarize with "
                             "tools/telemetry_report.py")
+        p.add_argument("--trace", dest="trace", action="store_true",
+                       help="request-scoped tracing: span trees for "
+                            "serving requests and train steps in the "
+                            "telemetry event log (requires "
+                            "--telemetry_dir); render with "
+                            "tools/trace_report.py")
+        p.add_argument("--watchdog_stall_s", dest="watchdog_stall_s",
+                       type=float, default=None,
+                       help="stall watchdog progress deadline in "
+                            "seconds for the train loop / infeed "
+                            "producer / checkpoint writer / serving "
+                            "batcher (0 = off; requires "
+                            "--telemetry_dir)")
+        p.add_argument("--watchdog_mode", dest="watchdog_mode",
+                       default=None, choices=["warn", "raise"],
+                       help="on a missed deadline: warn (record + "
+                            "dump diagnostics, keep running) or raise "
+                            "(sticky StallError)")
         p.add_argument("--serve_batch_max", dest="serve_batch_max",
                        type=int, default=None,
                        help="max methods per coalesced serving batch "
@@ -636,6 +678,12 @@ class Config:
             cfg.TENSORBOARD_DIR = ns.tensorboard_dir
         if ns.telemetry_dir is not None:
             cfg.TELEMETRY_DIR = ns.telemetry_dir
+        if ns.trace:
+            cfg.TRACE = True
+        if ns.watchdog_stall_s is not None:
+            cfg.WATCHDOG_STALL_S = ns.watchdog_stall_s
+        if ns.watchdog_mode is not None:
+            cfg.WATCHDOG_MODE = ns.watchdog_mode
         if ns.serve_batch_max is not None:
             cfg.SERVE_BATCH_MAX = ns.serve_batch_max
         if ns.serve_batch_timeout_ms is not None:
@@ -762,6 +810,20 @@ class Config:
             raise ValueError("--serve_cache_size must be >= 0.")
         if self.SERVE_EXTRACT_WORKERS < 1:
             raise ValueError("--serve_extract_workers must be >= 1.")
+        if self.TRACE and not self.TELEMETRY_DIR:
+            raise ValueError(
+                "--trace requires --telemetry_dir (spans are recorded "
+                "through the run's JSONL event log).")
+        if self.WATCHDOG_STALL_S < 0:
+            raise ValueError("--watchdog_stall_s must be >= 0.")
+        if self.WATCHDOG_STALL_S > 0 and not self.TELEMETRY_DIR:
+            raise ValueError(
+                "--watchdog_stall_s requires --telemetry_dir (stall "
+                "events and diagnostic dumps live in the run dir).")
+        if self.WATCHDOG_MODE not in ("warn", "raise"):
+            raise ValueError(
+                "--watchdog_mode must be warn or raise "
+                f"(got {self.WATCHDOG_MODE!r}).")
         if self.LR_WARMUP_STEPS < 0:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:

@@ -47,6 +47,13 @@ class _ThreadedInfeed:
     def __init__(self, depth: int):
         assert depth >= 1
         self._depth = depth
+        # optional obs.watchdog Heartbeat: the producer thread beats on
+        # every queue-put attempt (a put blocked on a FULL queue still
+        # beats — that means the CONSUMER is slow, not the producer
+        # stuck) and goes idle when its passes are done, so "infeed
+        # producer wedged in parse/transfer" is distinguishable from
+        # "nothing left to produce"
+        self._heartbeat = None
 
     def _produce(self, put: Callable) -> None:
         raise NotImplementedError
@@ -57,10 +64,13 @@ class _ThreadedInfeed:
     def __iter__(self) -> Iterator[Tuple]:
         q: queue.Queue = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
+        heartbeat = self._heartbeat
 
         def put(item) -> bool:
             # bounded-wait put so shutdown can interrupt a full queue
             while not stop.is_set():
+                if heartbeat is not None:
+                    heartbeat.beat()
                 try:
                     q.put(item, timeout=0.1)
                     return True
@@ -73,8 +83,13 @@ class _ThreadedInfeed:
                 self._produce(put)
             except BaseException as e:  # propagate into the consumer
                 put((_SENTINEL, e))
-                return
-            put((_SENTINEL, None))
+            else:
+                put((_SENTINEL, None))
+            finally:
+                # idle LAST (the sentinel put itself beats): a finished
+                # producer is exempt from the deadline, not stalled
+                if heartbeat is not None:
+                    heartbeat.idle()
 
         thread = threading.Thread(target=run, daemon=True)
         thread.start()
@@ -241,9 +256,12 @@ def persistent_epochs(infeed, num_epochs: int
 
     q: queue.Queue = queue.Queue(maxsize=infeed._depth)
     stop = threading.Event()
+    heartbeat = infeed._heartbeat
 
     def put(item) -> bool:
         while not stop.is_set():
+            if heartbeat is not None:
+                heartbeat.beat()
             try:
                 q.put(item, timeout=0.1)
                 return True
@@ -259,8 +277,13 @@ def persistent_epochs(infeed, num_epochs: int
                     return
         except BaseException as e:  # surfaces at the consumer position
             put((_SENTINEL, e))
-            return
-        put((_SENTINEL, None))
+        else:
+            put((_SENTINEL, None))
+        finally:
+            # idle LAST (the sentinel put itself beats): the producer
+            # finishing all passes is exempt, not stalled
+            if heartbeat is not None:
+                heartbeat.idle()
 
     thread = threading.Thread(target=run, daemon=True,
                               name="train-infeed")
@@ -299,15 +322,31 @@ def persistent_epochs(infeed, num_epochs: int
 def build_train_infeed(reader: Iterable, *, chunk: int, depth: int,
                        mesh, host_arrays_fn: Callable,
                        device_batch_fn: Callable,
-                       log: Callable) -> Iterable[Tuple]:
+                       log: Callable, instrument: Callable = None,
+                       heartbeat=None) -> Iterable[Tuple]:
     """The train-loop infeed both model heads share: chunked
     (latency-amortizing, single-device only) when --infeed_chunk > 1,
     else depth-prefetched; logs instead of silently ignoring the chunk
-    request when a mesh forces the fallback."""
+    request when a mesh forces the fallback.
+
+    `instrument` (ISSUE 6 tracing) wraps the per-batch producer-side
+    function — it runs on the PRODUCER thread once per batch, so the
+    model can emit an `infeed/produce` span and send its context down
+    a SpanChannel without changing the queue's item shape. `heartbeat`
+    is the producer's obs.watchdog Heartbeat (beaten on every queue
+    put attempt). Both default to off and cost nothing when unset."""
+    if instrument is not None:
+        host_arrays_fn = instrument(host_arrays_fn)
+        device_batch_fn = instrument(device_batch_fn)
     if chunk > 1 and mesh is None:
-        return ChunkedDevicePrefetcher(reader, host_arrays_fn, chunk,
-                                       depth=max(1, depth))
+        infeed = ChunkedDevicePrefetcher(reader, host_arrays_fn, chunk,
+                                         depth=max(1, depth))
+        infeed._heartbeat = heartbeat
+        return infeed
     if chunk > 1:
         log("--infeed_chunk ignored: chunked infeed is single-device "
             "only (mesh active); using depth prefetch")
-    return prefetch_to_device(reader, device_batch_fn, depth)
+    infeed = prefetch_to_device(reader, device_batch_fn, depth)
+    if isinstance(infeed, _ThreadedInfeed):
+        infeed._heartbeat = heartbeat
+    return infeed

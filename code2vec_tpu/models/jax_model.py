@@ -300,13 +300,15 @@ class Code2VecModel(Code2VecModelBase):
         # prefetch thread parse-only (round-4 infeed A/B finding)
         return tuple(jnp.asarray(a) for a in arrays)
 
-    def _train_infeed(self, reader):
+    def _train_infeed(self, reader, instrument=None, heartbeat=None):
         from code2vec_tpu.data.prefetch import build_train_infeed
         return build_train_infeed(
             reader, chunk=self.config.INFEED_CHUNK,
             depth=self.config.INFEED_PREFETCH, mesh=self.mesh,
             host_arrays_fn=self._host_batch_arrays,
-            device_batch_fn=self._device_batch, log=self.log)
+            device_batch_fn=self._device_batch, log=self.log,
+            instrument=instrument, heartbeat=heartbeat)
+
 
     def _ids_to_words(self, topk_ids: np.ndarray) -> List[List[str]]:
         tv = self.vocabs.target_vocab
@@ -334,17 +336,38 @@ class Code2VecModel(Code2VecModelBase):
         # --telemetry_dir is set; the disabled path is one boolean check
         # per step (recorder.enabled) and wrap() returns the infeed
         # unchanged.
-        from code2vec_tpu.obs import Telemetry, TrainStepRecorder
+        from code2vec_tpu.obs import (SpanChannel, Telemetry, Tracer,
+                                      TrainStepRecorder, Watchdog)
         telemetry = Telemetry.create(
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", scalar_writer=scalars, log=self.log)
         self.telemetry = telemetry
-        if cfg.ASYNC_CHECKPOINT:
-            # the background writer records save_total_ms from its own
-            # thread into this registry
+        if cfg.ASYNC_CHECKPOINT or cfg.TRACE or cfg.WATCHDOG_STALL_S > 0:
+            # the checkpoint writer, the infeed producer (trace spans)
+            # and the watchdog monitor all record into this registry
+            # from their own threads
             telemetry.make_threadsafe()
+        # request-scoped tracing (--trace) + stall watchdog
+        # (--watchdog_stall_s): per-step span trees linking the infeed
+        # batch consumed and the async save triggered, and liveness
+        # deadlines on the loop / infeed producer / checkpoint writer.
+        # Off (the defaults), both are shared no-op singletons.
+        tracer = Tracer.create(telemetry) if cfg.TRACE \
+            else Tracer.disabled()
+        self.tracer = tracer
+        watchdog = Watchdog.create(
+            telemetry, stall_s=cfg.WATCHDOG_STALL_S,
+            mode=cfg.WATCHDOG_MODE, tracer=tracer, log=self.log)
+        loop_hb = watchdog.register("train_loop")
+        self._ckpt_heartbeat = watchdog.register("checkpoint_writer")
+        infeed_channel = SpanChannel() if tracer.enabled else None
         recorder = TrainStepRecorder(
-            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
+            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS,
+            tracer=tracer, infeed_channel=infeed_channel,
+            heartbeat=loop_hb if watchdog.enabled else None)
+        self._trace_recorder = recorder
+        watchdog.start()
+        loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
         # host->device transfer of batch k+1 overlap step k on a daemon
@@ -354,7 +377,12 @@ class Code2VecModel(Code2VecModelBase):
         # boundary save + eval run) instead of cold-restarting it and
         # re-filling the double buffer each epoch.
         from code2vec_tpu.data.prefetch import persistent_epochs
-        infeed = self._train_infeed(reader)
+        from code2vec_tpu.obs import infeed_produce_instrument
+        infeed_hb = watchdog.register("infeed_producer")
+        infeed = self._train_infeed(
+            reader,
+            instrument=infeed_produce_instrument(tracer, infeed_channel),
+            heartbeat=infeed_hb if watchdog.enabled else None)
         try:
             for epoch, epoch_batches in persistent_epochs(
                     infeed, cfg.NUM_TRAIN_EPOCHS):
@@ -411,6 +439,10 @@ class Code2VecModel(Code2VecModelBase):
                                     eval_ms=round(eval_ms, 3))
                     epoch_end_work = True
                 if epoch_end_work:
+                    # boundary work is progress: re-arm the loop's
+                    # deadline so a long save/eval doesn't read as a
+                    # stall (size --watchdog_stall_s above eval time)
+                    loop_hb.beat()
                     # reset the throughput window: checkpoint + eval wall
                     # time must not be silently absorbed into the next
                     # epoch's first ex/s figure
@@ -420,7 +452,10 @@ class Code2VecModel(Code2VecModelBase):
                 # checkpoint's `state` rename committed (re-raises a
                 # background write failure)
                 self._ckpt_writer.wait()
+            watchdog.poll()  # raise-mode: a stalled run dies loudly here
         finally:
+            loop_hb.idle()
+            watchdog.stop()  # no re-raise: must not mask loop errors
             if self._ckpt_writer is not None:
                 # exception-path teardown: drain without
                 # masking the in-flight error (a sticky
@@ -559,8 +594,14 @@ class Code2VecModel(Code2VecModelBase):
         (`decode_predictions`) so the serving batcher can fan it out to
         client threads instead of serializing it after every batch."""
         n = prepared.n
-        # host phase: rows -> padded device batch (serve/encode_ms)
+        # host phase: rows -> padded device batch (serve/encode_ms).
+        # Trace spans (--trace) parent implicitly to the batcher's
+        # serve/batch_flush span (thread-local current — this runs ON
+        # the batcher thread when serving); off = one boolean check.
+        tracing = self.tracer.enabled
         encode_span = self.telemetry.span("serve/encode_ms")
+        t_encode = self.tracer.start_span("serve/encode", n=n) \
+            if tracing else None
         padded_n = self.predict_bucket_size(n)
         weights = np.zeros((padded_n,), dtype=np.float32)
         weights[:n] = 1.0
@@ -570,16 +611,23 @@ class Code2VecModel(Code2VecModelBase):
         batch = (labels, src, pth, dst, mask, weights)
         if self.mesh is not None:
             batch = shard_batch(self.mesh, batch, process_local=False)
+        if t_encode is not None:
+            t_encode.end()
         encode_span.stop()
         # device phase: jitted step + host fetch (serve/predict_ms; the
         # fetch_global transfers are the device sync)
         predict_span = self.telemetry.span("serve/predict_ms")
+        t_device = self.tracer.start_span("serve/device",
+                                          padded_n=padded_n) \
+            if tracing else None
         topk_ids, topk_probs, attn, code = self._predict_step(
             self.params, batch)
         topk_ids = fetch_global(topk_ids)[:n]
         topk_probs = fetch_global(topk_probs)[:n]
         attn = fetch_global(attn)[:n]
         code = fetch_global(code)[:n]
+        if t_device is not None:
+            t_device.end()
         predict_span.stop()
         return topk_ids, topk_probs, attn, code
 
@@ -634,7 +682,9 @@ class Code2VecModel(Code2VecModelBase):
     # ---- persistence ----
     def _checkpoint_writer(self) -> "ckpt.AsyncCheckpointWriter":
         if self._ckpt_writer is None:
-            self._ckpt_writer = ckpt.AsyncCheckpointWriter(log=self.log)
+            self._ckpt_writer = ckpt.AsyncCheckpointWriter(
+                log=self.log,
+                heartbeat=getattr(self, "_ckpt_heartbeat", None))
         return self._ckpt_writer
 
     def save(self, path: Optional[str] = None, block: bool = True) -> None:
@@ -668,12 +718,29 @@ class Code2VecModel(Code2VecModelBase):
                  "adv_rename_prob": self.config.ADV_RENAME_PROB,
                  "adv_rename_mode": self.config.ADV_RENAME_MODE}
         blocked_span = self.telemetry.span("train/save_blocked_ms")
+        # trace (--trace): the save's blocked window LINKS the step that
+        # triggered it (the per-step trace the recorder keeps current),
+        # and the writer thread parents its train/save_write span to
+        # this context — the step -> save -> commit chain is one walk
+        trace_span = None
+        if self.tracer.enabled:
+            rec = getattr(self, "_trace_recorder", None)
+            last = rec.last_step_context if rec is not None else None
+            trace_span = self.tracer.start_trace(
+                "train/save_blocked", step=int(self.step_num),
+                is_async=bool(self.config.ASYNC_CHECKPOINT))
+            if last is not None:
+                trace_span.links.append(last)
         if self.config.ASYNC_CHECKPOINT:
             writer = self._checkpoint_writer()
             writer.submit(path, state, self.step_num, self.vocabs,
                           self.dims, extra_manifest=extra,
                           max_to_keep=self.config.MAX_TO_KEEP,
-                          telemetry=self.telemetry)
+                          telemetry=self.telemetry,
+                          tracer=self.tracer if trace_span is not None
+                          else None,
+                          trace_ctx=trace_span.context()
+                          if trace_span is not None else None)
             if block:
                 writer.wait()
             blocked_ms = blocked_span.stop()
@@ -691,6 +758,8 @@ class Code2VecModel(Code2VecModelBase):
             self.telemetry.event("save_committed", step=self.step_num,
                                  total_ms=round(blocked_ms, 3))
             self.log(f"saved checkpoint step {self.step_num} -> {path}")
+        if trace_span is not None:
+            trace_span.end(blocked_ms=round(blocked_ms, 3))
         self.telemetry.event("save", step=self.step_num,
                              blocked_ms=round(blocked_ms, 3),
                              is_async=bool(self.config.ASYNC_CHECKPOINT))

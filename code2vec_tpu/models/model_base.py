@@ -86,9 +86,12 @@ class Code2VecModelBase(abc.ABC):
         # run telemetry (code2vec_tpu/obs/): train() replaces this with
         # a file-backed run when --telemetry_dir is set, and the serving
         # REPL injects its always-on latency registry; the disabled
-        # singleton keeps predict()'s span calls branch-free.
-        from code2vec_tpu.obs import Telemetry
+        # singleton keeps predict()'s span calls branch-free. Same deal
+        # for the request-scoped tracer (--trace): train() and the
+        # PredictionServer install a recording one.
+        from code2vec_tpu.obs import Telemetry, Tracer
         self.telemetry = Telemetry.disabled()
+        self.tracer = Tracer.disabled()
         self.vocabs: Code2VecVocabs = self._load_or_create_vocabs()
 
     # ---- lifecycle ----

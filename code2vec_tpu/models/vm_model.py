@@ -42,8 +42,9 @@ class VarMisuseModel:
     def __init__(self, config: Config):
         cfg = self.config = config
         self.log = cfg.log
-        from code2vec_tpu.obs import Telemetry
+        from code2vec_tpu.obs import Telemetry, Tracer
         self.telemetry = Telemetry.disabled()  # train() swaps it in
+        self.tracer = Tracer.disabled()        # ditto (--trace)
         self.compute_dtype = jnp.bfloat16 if cfg.USE_BF16 else jnp.float32
         # Pallas kernels are TPU-only; fall back to the XLA pool
         # elsewhere (tests run on the virtual CPU mesh).
@@ -160,24 +161,45 @@ class VarMisuseModel:
         # Unified run telemetry (code2vec_tpu/obs/) — same per-step
         # step_ms/infeed_wait_ms/loss records as the code2vec head; the
         # shared recorder keeps the two loops' metrics comparable.
-        from code2vec_tpu.obs import Telemetry, TrainStepRecorder
+        from code2vec_tpu.obs import (SpanChannel, Telemetry, Tracer,
+                                      TrainStepRecorder, Watchdog)
         telemetry = Telemetry.create(
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", log=self.log)
         self.telemetry = telemetry
-        if cfg.ASYNC_CHECKPOINT:
-            # the background writer records save_total_ms from its own
-            # thread into this registry
+        if cfg.ASYNC_CHECKPOINT or cfg.TRACE or cfg.WATCHDOG_STALL_S > 0:
+            # the checkpoint writer, the infeed producer (trace spans)
+            # and the watchdog monitor all record cross-thread
             telemetry.make_threadsafe()
+        # per-step tracing + stall watchdog — same wiring as jax_model
+        # (shared recorder/obs layer keeps the two loops comparable)
+        tracer = Tracer.create(telemetry) if cfg.TRACE \
+            else Tracer.disabled()
+        self.tracer = tracer
+        watchdog = Watchdog.create(
+            telemetry, stall_s=cfg.WATCHDOG_STALL_S,
+            mode=cfg.WATCHDOG_MODE, tracer=tracer, log=self.log)
+        loop_hb = watchdog.register("train_loop")
+        self._ckpt_heartbeat = watchdog.register("checkpoint_writer")
+        infeed_hb = watchdog.register("infeed_producer")
+        infeed_channel = SpanChannel() if tracer.enabled else None
         recorder = TrainStepRecorder(
-            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS)
+            telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS,
+            tracer=tracer, infeed_channel=infeed_channel,
+            heartbeat=loop_hb if watchdog.enabled else None)
+        self._trace_recorder = recorder
+        watchdog.start()
+        loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         from code2vec_tpu.data.prefetch import (build_train_infeed,
                                                 persistent_epochs)
+        from code2vec_tpu.obs import infeed_produce_instrument
         infeed = build_train_infeed(
             reader, chunk=cfg.INFEED_CHUNK, depth=cfg.INFEED_PREFETCH,
             mesh=self.mesh, host_arrays_fn=self._host_batch_arrays,
-            device_batch_fn=self._device_batch, log=self.log)
+            device_batch_fn=self._device_batch, log=self.log,
+            instrument=infeed_produce_instrument(tracer, infeed_channel),
+            heartbeat=infeed_hb if watchdog.enabled else None)
         # one warm producer thread across epoch boundaries (same as
         # jax_model): epoch k+1 parses/transfers during the boundary
         # save + eval instead of cold-restarting the double buffer
@@ -220,6 +242,8 @@ class VarMisuseModel:
                                     eval_ms=round(eval_ms, 3))
                     epoch_end_work = True
                 if epoch_end_work:
+                    # boundary work is progress for the loop's deadline
+                    loop_hb.beat()
                     # checkpoint/eval wall time must not leak into the next
                     # window's first ex/s figure (same fix as jax_model)
                     window, t0 = 0, time.time()
@@ -227,7 +251,10 @@ class VarMisuseModel:
                 # hard commit barrier: end of training (re-raises a
                 # background write failure)
                 self._ckpt_writer.wait()
+            watchdog.poll()  # raise-mode: a stalled run dies loudly here
         finally:
+            loop_hb.idle()
+            watchdog.stop()  # no re-raise: must not mask loop errors
             if self._ckpt_writer is not None:
                 # exception-path teardown: drain without
                 # masking the in-flight error (a sticky
@@ -308,15 +335,28 @@ class VarMisuseModel:
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS}
         blocked_span = self.telemetry.span("train/save_blocked_ms")
+        trace_span = None
+        if self.tracer.enabled:
+            rec = getattr(self, "_trace_recorder", None)
+            last = rec.last_step_context if rec is not None else None
+            trace_span = self.tracer.start_trace(
+                "train/save_blocked", step=int(self.step_num),
+                is_async=bool(self.config.ASYNC_CHECKPOINT))
+            if last is not None:
+                trace_span.links.append(last)
         if self.config.ASYNC_CHECKPOINT:
             if self._ckpt_writer is None:
                 self._ckpt_writer = ckpt.AsyncCheckpointWriter(
-                    log=self.log)
+                    log=self.log,
+                    heartbeat=getattr(self, "_ckpt_heartbeat", None))
             self._ckpt_writer.submit(
                 path, state, self.step_num, self.vocabs, self.dims,
                 extra_manifest=extra,
                 max_to_keep=self.config.MAX_TO_KEEP,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry,
+                tracer=self.tracer if trace_span is not None else None,
+                trace_ctx=trace_span.context()
+                if trace_span is not None else None)
             if block:
                 self._ckpt_writer.wait()
             blocked_ms = blocked_span.stop()
@@ -332,6 +372,8 @@ class VarMisuseModel:
                                  total_ms=round(blocked_ms, 3))
             self.log(f"saved varmisuse checkpoint step {self.step_num} "
                      f"-> {path}")
+        if trace_span is not None:
+            trace_span.end(blocked_ms=round(blocked_ms, 3))
         self.telemetry.event("save", step=self.step_num,
                              blocked_ms=round(blocked_ms, 3),
                              is_async=bool(self.config.ASYNC_CHECKPOINT))

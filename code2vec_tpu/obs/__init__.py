@@ -8,10 +8,15 @@ model heads share. Stdlib-only at import time — jax is lazy, TensorFlow
 is never imported here (guard: tests/test_obs_guard.py).
 """
 
-from code2vec_tpu.obs.loop import TrainStepRecorder  # noqa: F401
+from code2vec_tpu.obs.loop import (TrainStepRecorder,  # noqa: F401
+                                   infeed_produce_instrument)
 from code2vec_tpu.obs.sinks import (JsonlSink, ScalarSink,  # noqa: F401
                                     StdoutSink)
 from code2vec_tpu.obs.telemetry import (SUMMARY_PERCENTILES,  # noqa: F401
                                         Telemetry, TimerStat,
                                         device_sync,
                                         format_latency_line)
+from code2vec_tpu.obs.trace import (SpanChannel, SpanContext,  # noqa: F401
+                                    Tracer, TraceSpan)
+from code2vec_tpu.obs.watchdog import (Heartbeat, StallError,  # noqa: F401
+                                       Watchdog)

@@ -14,21 +14,60 @@ is the step device-bound or infeed-bound? Per step it records
   - periodic device-memory gauges (`bytes_in_use`,
     `peak_bytes_in_use`) where the backend exposes them.
 
+With a tracer attached (`--trace`, ISSUE 6) each step additionally
+becomes a trace: a `train/step_cycle` root span with `train/infeed_wait`
+and `train/step` children (recorded retroactively from the timings the
+recorder already took — no extra clock reads on the hot path beyond
+one), LINKING the `infeed/produce` span of the batch it consumed (the
+producer thread sends that span's context through a `SpanChannel` in
+lockstep with the infeed queue — obs/trace.py has the handoff
+discipline). `last_step_context` exposes the newest step's context so
+the epoch-boundary save can link the step that triggered it. A
+heartbeat (`--watchdog_stall_s`) beats once per step.
+
 Cost model: telemetry is opt-in (`--telemetry_dir`), and enabling it
 trades step pipelining for attribution — the per-step device sync
 serializes the loop (steps no longer overlap the next host dispatch).
 That is the documented price of in-band per-step numbers; the
 jax.profiler trace window (`--profile`) remains the non-intrusive tool.
 Disabled, the recorder costs ONE boolean check per step and `wrap()`
-returns the infeed unchanged — zero per-step allocation.
+returns the infeed unchanged — zero per-step allocation. Trace and
+watchdog ride the same discipline: off, they add one boolean check and
+one no-op method call per step.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Iterable
+from typing import Iterable, Optional
 
 from code2vec_tpu.obs.telemetry import Telemetry
+from code2vec_tpu.obs.trace import SpanChannel, SpanContext, Tracer
+
+
+def infeed_produce_instrument(tracer: Tracer,
+                              channel: Optional[SpanChannel]):
+    """Producer-side tracing hook for `build_train_infeed`: wraps the
+    per-batch parse/transfer function so each batch gets an
+    `infeed/produce` span ON the producer thread, whose context is
+    handed to the consuming step through `channel` (FIFO-aligned with
+    the infeed queue — the recorder links it from the step span).
+    Returns None when tracing is off, so the infeed path stays
+    byte-identical to the untraced one. ONE definition shared by both
+    train loops: the FIFO handoff contract must not drift between
+    them."""
+    if not tracer.enabled:
+        return None
+
+    def instrument(fn):
+        def produce(batch):
+            t0 = tracer.clock()
+            out = fn(batch)
+            channel.send(tracer.record_span(
+                "infeed/produce", t0, tracer.clock()))
+            return out
+        return produce
+    return instrument
 
 
 class TrainStepRecorder:
@@ -43,9 +82,16 @@ class TrainStepRecorder:
                     if rec.enabled else None
     """
 
-    def __init__(self, telemetry: Telemetry, gauge_every: int = 100):
+    def __init__(self, telemetry: Telemetry, gauge_every: int = 100,
+                 tracer: Optional[Tracer] = None,
+                 infeed_channel: Optional[SpanChannel] = None,
+                 heartbeat=None):
         self.enabled = telemetry.enabled
         self._tele = telemetry
+        self._tracer = tracer if tracer is not None else Tracer.disabled()
+        self._channel = infeed_channel
+        self._heartbeat = heartbeat
+        self.last_step_context: Optional[SpanContext] = None
         self._gauge_every = max(1, gauge_every)
         self._steps = 0
         self._infeed_wait_ms = 0.0
@@ -86,10 +132,39 @@ class TrainStepRecorder:
         tele.event("step", step=int(step), step_ms=round(step_ms, 3),
                    infeed_wait_ms=round(self._infeed_wait_ms, 3),
                    loss=round(loss_f, 6), examples=int(n_examples))
+        if self._heartbeat is not None:
+            self._heartbeat.beat()
+        if self._tracer.enabled:
+            self._trace_step(step, step_ms, n_examples)
         self._steps += 1
         if self._steps % self._gauge_every == 0:
             self._device_memory_gauges()
         return loss_f
+
+    def _trace_step(self, step: int, step_ms: float,
+                    n_examples: int) -> None:
+        """One trace per step, built retroactively from the timings
+        end_step already measured (the tracer clock and perf_counter
+        tick at the same rate; only the interval lengths matter).
+        Root `train/step_cycle` = infeed wait + step; its `train/step`
+        child links the consumed batch's `infeed/produce` span via the
+        producer's SpanChannel (FIFO-aligned with the infeed queue)."""
+        tracer = self._tracer
+        t_end = tracer.clock()
+        t_yield = t_end - step_ms / 1e3
+        t_wait0 = t_yield - self._infeed_wait_ms / 1e3
+        produced = self._channel.recv() if self._channel is not None \
+            else None
+        root = tracer.record_span(
+            "train/step_cycle", t_wait0, t_end, parent=None,
+            step=int(step), examples=int(n_examples))
+        tracer.record_span("train/infeed_wait", t_wait0, t_yield,
+                           parent=root)
+        tracer.record_span(
+            "train/step", t_yield, t_end, parent=root,
+            links=(produced,) if produced is not None else (),
+            step=int(step))
+        self.last_step_context = root
 
     def _device_memory_gauges(self) -> None:
         try:

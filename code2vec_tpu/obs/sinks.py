@@ -76,7 +76,7 @@ class StdoutSink:
     gauges, summaries) through the run's logger."""
 
     def __init__(self, log: Callable[[str], None],
-                 skip_kinds: Sequence[str] = ("step",)):
+                 skip_kinds: Sequence[str] = ("step", "span")):
         self._log = log
         self._skip = frozenset(skip_kinds)
 

@@ -76,7 +76,7 @@ class TimerStat:
     percentiles, which is what a long run wants anyway: p99 of the
     current regime, not of compile-step outliers hours ago)."""
 
-    __slots__ = ("count", "total_ms", "max_ms", "_ring", "_cap")
+    __slots__ = ("count", "total_ms", "max_ms", "_ring", "_cap", "_lock")
 
     def __init__(self, cap: int = 2048):
         assert cap >= 1
@@ -85,6 +85,9 @@ class TimerStat:
         self.max_ms = 0.0
         self._cap = cap
         self._ring: list = []
+        # installed by Telemetry.make_threadsafe() (the OWNING
+        # registry's lock): percentile reads then snapshot under it
+        self._lock: Optional[threading.RLock] = None
 
     def record(self, ms: float) -> None:
         self.count += 1
@@ -101,12 +104,26 @@ class TimerStat:
         return self.total_ms / self.count if self.count else float("nan")
 
     def percentile(self, p: float) -> float:
-        """Nearest-rank percentile over the sample window."""
-        if not self._ring:
-            return float("nan")
-        # snapshot first: serving reads percentiles while other threads
-        # record (GIL makes the copy itself safe)
-        s = sorted(list(self._ring))
+        """Nearest-rank percentile over the sample window.
+
+        Threadsafe mode (the registry's `make_threadsafe()`) installs
+        the registry lock here, so the ring snapshot cannot interleave
+        with a concurrent `record` from another thread. WITHOUT the
+        lock (the train loop's single-threaded fast path) the snapshot
+        relies on CPython list-copy atomicity under the GIL — safe only
+        when every `record` happens on the reading thread; concurrent
+        lock-free use could sort a ring mid-mutation and return a
+        value from a torn window."""
+        lock = self._lock
+        if lock is not None:
+            with lock:
+                if not self._ring:
+                    return float("nan")
+                s = sorted(self._ring)
+        else:
+            if not self._ring:
+                return float("nan")
+            s = sorted(list(self._ring))
         k = int(round(p / 100.0 * (len(s) - 1)))
         return s[max(0, min(len(s) - 1, k))]
 
@@ -216,10 +233,14 @@ class Telemetry:
 
     def make_threadsafe(self) -> "Telemetry":
         """Install an RLock around the mutating surface (count / gauge /
-        record_ms / event / summary / close). Returns self, so call
-        sites can chain: `Telemetry.memory("serve").make_threadsafe()`."""
+        record_ms / event / summary / close) and onto every timer's
+        percentile reads (existing and future — TimerStat.percentile).
+        Returns self, so call sites can chain:
+        `Telemetry.memory("serve").make_threadsafe()`."""
         if self._lock is None:
             self._lock = threading.RLock()
+            for t in self.timers.values():
+                t._lock = self._lock
         return self
 
     # shared stateless instance: the lock-free path must not allocate
@@ -287,6 +308,7 @@ class Telemetry:
             t = self.timers.get(name)
             if t is None:
                 t = self.timers[name] = TimerStat()
+                t._lock = self._lock  # threadsafe-mode percentile reads
             return t
 
     def record_ms(self, name: str, ms: float) -> None:

@@ -145,11 +145,21 @@ def fetch_global(x):
     Single-process: plain np.asarray. Multi-process: allgather the
     process-local shards over the coordination backend so host-side code
     (metrics, prediction decoding) sees the full batch everywhere.
+
+    This IS the deliberate device->host sync that ends the predict /
+    eval hot paths — the results must reach the host to be decoded, and
+    the predict path's `serve/predict_ms` telemetry span (jax_model.
+    predict_device) budgets it explicitly. Hence the inline host-sync
+    suppressions below rather than baseline entries (graftlint tiering:
+    suppress-with-reason > baseline; ISSUE 6 burned the last baseline
+    entries down to zero).
     """
     import jax
     import numpy as np
 
     if jax.process_count() == 1:
-        return np.asarray(x)
+        # graftlint: disable=host-sync-in-hot-path
+        return np.asarray(x)  # the deliberate result fetch (docstring)
     from jax.experimental import multihost_utils
+    # graftlint: disable=host-sync-in-hot-path
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -40,13 +40,21 @@ class PredictRequest:
     server passes pre-parsed `PreparedRows`), its leading-dim size `n`,
     and an absolute monotonic `deadline` (None = no deadline). The
     submitting thread blocks on `wait()`; the batcher thread resolves it
-    via `finish()` / `fail()`."""
+    via `finish()` / `fail()`.
+
+    `trace_ctx` (ISSUE 6) is the request-scoped tracing handoff: an
+    opaque `obs.trace.SpanContext` the CLIENT thread attaches and the
+    batcher-thread flush reads to parent/link its spans — the batcher
+    itself never starts or ends spans (it stays stdlib-only and
+    trace-agnostic; `enqueued_at` doubles as the queue-wait span's
+    start because both use `time.monotonic`, the tracer's clock)."""
 
     __slots__ = ("rows", "n", "deadline", "enqueued_at", "result",
-                 "error", "_done", "_lock")
+                 "error", "trace_ctx", "_done", "_lock")
 
     def __init__(self, rows: Any, n: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_ctx: Any = None):
         assert n >= 1, "empty requests never reach the batcher"
         self.rows = rows
         self.n = n
@@ -54,6 +62,7 @@ class PredictRequest:
         self.enqueued_at = time.monotonic()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        self.trace_ctx = trace_ctx
         self._done = threading.Event()
         self._lock = threading.Lock()
 

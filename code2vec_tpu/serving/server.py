@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from code2vec_tpu.common import MethodPredictionResults
 from code2vec_tpu.config import Config
-from code2vec_tpu.obs import Telemetry
+from code2vec_tpu.obs import Telemetry, Tracer, Watchdog
 from code2vec_tpu.serving.batcher import (MicroBatcher, PredictRequest,
                                           ServerOverloaded)
 from code2vec_tpu.serving.extractor import ExtractorPool
@@ -105,7 +105,8 @@ class PredictionServer:
     extractor pool around one model. `InteractivePredictor` is a thin
     client of this; `tools/loadgen.py` drives it at target QPS."""
 
-    def __init__(self, config: Config, model, telemetry: Telemetry = None):
+    def __init__(self, config: Config, model, telemetry: Telemetry = None,
+                 tracer: Tracer = None, watchdog: Watchdog = None):
         self.config = config
         self.model = model
         tele = telemetry if telemetry is not None \
@@ -115,6 +116,26 @@ class PredictionServer:
         # the model's serve/encode_ms + serve/predict_ms spans land in
         # the same registry (as the REPL always arranged)
         model.telemetry = tele
+        # request-scoped tracing (--trace, ISSUE 6): the client threads
+        # open request/parse/decode spans, the batcher flush continues
+        # them (serve/batch_flush + serve/encode + serve/device) via the
+        # SpanContext riding each PredictRequest. Off = one boolean
+        # check per request (the shared disabled tracer).
+        if tracer is None:
+            tracer = Tracer.create(tele) \
+                if getattr(config, "TRACE", False) else Tracer.disabled()
+        self.tracer = tracer
+        model.tracer = tracer
+        # stall watchdog (--watchdog_stall_s): the batcher consumer
+        # heartbeats per flush — a hung device call or wedged flush
+        # surfaces as a `stall` event + diagnostic dump
+        if watchdog is None:
+            watchdog = Watchdog.create(
+                tele, stall_s=getattr(config, "WATCHDOG_STALL_S", 0.0),
+                mode=getattr(config, "WATCHDOG_MODE", "warn"),
+                tracer=tracer, log=getattr(config, "log", None))
+        self.watchdog = watchdog
+        self._batcher_hb = watchdog.register("batcher_consumer")
         self.cache = PredictionCache(config.SERVE_CACHE_SIZE)
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=config.SERVE_BATCH_MAX,
@@ -142,6 +163,7 @@ class PredictionServer:
                     warmup_ms=round((time.perf_counter() - t0) * 1e3, 1),
                     compiled=self.model.predict_compile_count())
             self.batcher.start()
+            self.watchdog.start()
             self._started = True
         return self
 
@@ -153,6 +175,10 @@ class PredictionServer:
                 self._extractors = None
                 self._extractor_kwargs = None
             self._started = False
+        self.watchdog.stop()
+        # after teardown so a raise-mode sticky stall cannot leak the
+        # batcher/extractor threads by raising mid-close
+        self.watchdog.poll()
 
     def extractor_pool(self, **extractor_kwargs) -> ExtractorPool:
         """The persistent extraction pool, built (and preflighted) once
@@ -180,18 +206,34 @@ class PredictionServer:
         extract + predict end-to-end, exactly as the pre-server REPL
         recorded it."""
         request_span = self.telemetry.span("serve/request_ms")
+        root = self.tracer.start_trace("serve/request", file=path) \
+            if self.tracer.enabled else None
         span = self.telemetry.span("serve/extract_ms")
-        _, lines = self.extractor_pool(**extractor_kwargs) \
-            .extract_paths(path)
+        ex_span = self.tracer.start_span("serve/extract", parent=root) \
+            if root is not None else None
+        try:
+            _, lines = self.extractor_pool(**extractor_kwargs) \
+                .extract_paths(path)
+        except BaseException:
+            # close the trace on the error path too — an un-ended root
+            # would sit in the live-span table forever (and pollute
+            # every watchdog stall dump with phantom requests)
+            if root is not None:
+                ex_span.end()
+                root.end(outcome="error")
+            raise
+        if ex_span is not None:
+            ex_span.end()
         extract_ms = span.stop()
         return self.predict_lines(lines, deadline_ms=deadline_ms,
                                   extract_ms=extract_ms,
-                                  _request_span=request_span)
+                                  _request_span=request_span,
+                                  _trace_root=root)
 
     def predict_lines(self, lines: Sequence[str],
                       deadline_ms: float = None,
                       extract_ms: float = None,
-                      _request_span=None
+                      _request_span=None, _trace_root=None
                       ) -> List[MethodPredictionResults]:
         """Predict a bag of extractor lines (one result per non-empty
         line, input order). Raises `ServerOverloaded` when shed by
@@ -202,8 +244,17 @@ class PredictionServer:
             self.start()
         request_span = (_request_span if _request_span is not None
                         else self.telemetry.span("serve/request_ms"))
+        # request-scoped trace root: ONE trace id follows this request
+        # through the queue, the batcher flush, the device call and the
+        # client-thread decode (--trace; off = one boolean check)
+        root = _trace_root
+        if root is None and self.tracer.enabled:
+            root = self.tracer.start_trace("serve/request",
+                                           n_methods=len(lines))
         lines = [ln for ln in lines if ln.strip()]
         if not lines:
+            if root is not None:
+                root.end(n_results=0)
             return []
         if deadline_ms is None:
             deadline_ms = self.config.SERVE_DEADLINE_MS
@@ -233,14 +284,30 @@ class PredictionServer:
             # host parse on the CALLER's thread — the batcher only sees
             # ready-to-pad rows; oversized requests chunk to max_batch
             # so every flush stays inside the warmed buckets
-            prepared = self.model.prepare_predict_rows(
-                [lines[i] for i in miss_idx])
+            parse_span = self.tracer.start_span(
+                "serve/parse", parent=root, n=len(miss_idx)) \
+                if root is not None else None
+            try:
+                prepared = self.model.prepare_predict_rows(
+                    [lines[i] for i in miss_idx])
+            except BaseException:
+                # malformed input: close the trace instead of leaking
+                # root/parse into the live-span table on every bad
+                # request a long-running server sees
+                if root is not None:
+                    parse_span.end()
+                    root.end(outcome="error")
+                raise
+            if parse_span is not None:
+                parse_span.end()
+            root_ctx = root.context() if root is not None else None
             cap = self.batcher.max_batch
             chunks = [prepared.slice(at, min(at + cap, prepared.n))
                       for at in range(0, prepared.n, cap)]
             reqs = []
             for chunk in chunks:
-                req = PredictRequest(chunk, chunk.n, deadline=deadline)
+                req = PredictRequest(chunk, chunk.n, deadline=deadline,
+                                     trace_ctx=root_ctx)
                 if not self.batcher.submit(req):
                     # shed the WHOLE request: resolve the sibling
                     # chunks already queued so the batcher skips them
@@ -257,9 +324,12 @@ class PredictionServer:
                         if prev.fail(overload):
                             n_shed += 1
                     self.telemetry.count("serve/shed", n_shed)
+                    if root is not None:
+                        root.end(outcome="shed")
                     raise overload
                 reqs.append(req)
             miss_results: List[MethodPredictionResults] = []
+            decode_span = None
             try:
                 for chunk, req in zip(chunks, reqs):
                     # wait past the deadline by one batch window so an
@@ -279,14 +349,23 @@ class PredictionServer:
                     # decode on the CALLER's thread: the batcher's
                     # critical path stays device-only, decode
                     # parallelizes across clients
+                    decode_span = self.tracer.start_span(
+                        "serve/decode", parent=root, n=chunk.n) \
+                        if root is not None else None
                     miss_results.extend(self.model.decode_predictions(
                         chunk, req.result))
+                    if decode_span is not None:
+                        decode_span.end()
             except BaseException:
                 # resolve any still-pending sibling chunks so the
                 # batcher skips them (no device work for a dead waiter)
                 dead = ServerOverloaded("sibling chunk failed")
                 for r in reqs:
                     r.fail(dead)
+                if root is not None:
+                    if decode_span is not None:
+                        decode_span.end()  # idempotent: safe if closed
+                    root.end(outcome="error")
                 raise
             for i, res in zip(miss_idx, miss_results):
                 out[i] = res
@@ -295,6 +374,9 @@ class PredictionServer:
 
         self.telemetry.count("serve/requests")
         request_ms = request_span.stop()
+        if root is not None:
+            root.end(n_results=len(lines),
+                     n_cached=len(lines) - len(miss_idx))
         fields = {"request_ms": round(request_ms, 3),
                   "n_methods": len(lines),
                   "n_cached": len(lines) - len(miss_idx)}
@@ -307,10 +389,45 @@ class PredictionServer:
     def _run_batch(self, requests: Sequence[PredictRequest]) -> List:
         """One coalesced device call; each request gets back the row
         slice of the device output matching its own rows (numpy views —
-        no copy). Decode happens on the waiting client's thread."""
+        no copy). Decode happens on the waiting client's thread.
+
+        Tracing (--trace): the flush CONTINUES the first request's
+        trace (parent = its root span context, so that request's
+        queue -> batch -> device chain shares one trace id) and LINKS
+        every other coalesced request — the many-to-one edges
+        trace_report renders as Chrome flow events. Each request also
+        gets a retroactive `serve/queue_wait` span built from its
+        `enqueued_at` (same monotonic clock as the tracer). The span
+        contexts were handed off BY the client threads; this thread
+        only starts spans of its own, never ends theirs."""
         from code2vec_tpu.models.jax_model import PreparedRows
+        self._batcher_hb.busy()
         prepared = PreparedRows.concat([r.rows for r in requests])
-        out = self.model.predict_device(prepared)
+        flush_span = None
+        if self.tracer.enabled:
+            now = self.tracer.clock()
+            ctxs = [r.trace_ctx for r in requests
+                    if r.trace_ctx is not None]
+            for r in requests:
+                if r.trace_ctx is not None:
+                    self.tracer.record_span(
+                        "serve/queue_wait", r.enqueued_at, now,
+                        parent=r.trace_ctx, track="serve-queue")
+            flush_span = self.tracer.start_span(
+                "serve/batch_flush",
+                parent=ctxs[0] if ctxs else None,
+                links=ctxs[1:], n_requests=len(requests),
+                n_methods=prepared.n)
+        try:
+            if flush_span is not None:
+                # context manager: serve/encode + serve/device inside
+                # predict_device implicitly parent to the flush span
+                with flush_span:
+                    out = self.model.predict_device(prepared)
+            else:
+                out = self.model.predict_device(prepared)
+        finally:
+            self._batcher_hb.idle()
         split = []
         at = 0
         for r in requests:

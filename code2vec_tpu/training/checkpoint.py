@@ -185,15 +185,21 @@ class AsyncCheckpointWriter:
         hours past a dead disk.
 
     `save_fn` is injectable for crash-safety tests (simulate a writer
-    killed before the `state` rename commits)."""
+    killed before the `state` rename commits). `heartbeat` is the
+    obs.watchdog liveness hook (--watchdog_stall_s): busy at job
+    pickup, idle after commit — a write hung in orbax/disk I/O stops
+    beating and the watchdog dumps the writer thread's stack instead
+    of the run going silently wedged."""
 
     def __init__(self, log: Optional[Callable[[str], None]] = None,
-                 save_fn: Optional[Callable] = None):
+                 save_fn: Optional[Callable] = None,
+                 heartbeat=None):
         self._log = log or (lambda _m: None)
         # None -> module-level save_checkpoint, resolved at WRITE time
         # (tests monkeypatch the module function to inject slow disks
         # and torn writes)
         self._save_fn = save_fn
+        self._heartbeat = heartbeat
         self._cond = threading.Condition()
         self._job: Optional[Dict[str, Any]] = None
         self._error: Optional[BaseException] = None
@@ -211,10 +217,14 @@ class AsyncCheckpointWriter:
     def submit(self, ckpt_dir: str, state: Dict[str, Any], step: int,
                vocabs: Code2VecVocabs, dims: ModelDims, *,
                extra_manifest: Optional[Dict[str, Any]] = None,
-               max_to_keep: int = 10, telemetry=None) -> None:
+               max_to_keep: int = 10, telemetry=None,
+               tracer=None, trace_ctx=None) -> None:
         """Snapshot `state` and queue the save. Blocks only on the
         snapshot dispatch — unless a previous save is still in flight,
-        in which case it blocks until that one commits."""
+        in which case it blocks until that one commits. `trace_ctx`
+        (with its `tracer`) is the cross-thread span handoff: the
+        writer parents its `train/save_write` span to the loop-side
+        save span that queued this job."""
         snap = snapshot_state(state)
         with self._cond:
             self._raise_pending()
@@ -228,6 +238,7 @@ class AsyncCheckpointWriter:
                 "vocabs": vocabs, "dims": dims,
                 "extra_manifest": extra_manifest,
                 "max_to_keep": max_to_keep, "telemetry": telemetry,
+                "tracer": tracer, "trace_ctx": trace_ctx,
                 "t_submit": time.perf_counter(),
             }
             if self._thread is None:
@@ -244,14 +255,25 @@ class AsyncCheckpointWriter:
                 if self._job is None:
                     return  # closed and drained
                 job = self._job
+            hb = self._heartbeat
             try:
+                if hb is not None:
+                    hb.busy()  # deadline clock runs while writing
                 t0 = time.perf_counter()
+                tracer = job["tracer"]
+                t0_trace = tracer.clock() if tracer is not None else 0.0
                 save_fn = self._save_fn or save_checkpoint
                 save_fn(job["ckpt_dir"], job["state"], job["step"],
                         job["vocabs"], job["dims"],
                         extra_manifest=job["extra_manifest"],
                         max_to_keep=job["max_to_keep"])
                 total_ms = (time.perf_counter() - t0) * 1e3
+                if tracer is not None:
+                    # writer-side span, parented (cross-thread) to the
+                    # loop's save span via the handed-off context
+                    tracer.record_span(
+                        "train/save_write", t0_trace, tracer.clock(),
+                        parent=job["trace_ctx"], step=int(job["step"]))
                 tele = job["telemetry"]
                 if tele is not None:
                     tele.record_ms("train/save_total_ms", total_ms)
@@ -264,6 +286,8 @@ class AsyncCheckpointWriter:
                 with self._cond:
                     self._error = e
             finally:
+                if hb is not None:
+                    hb.idle()
                 with self._cond:
                     self._job = None
                     self._cond.notify_all()

@@ -99,3 +99,52 @@ def test_loadgen_long_run_cli(tmp_path, capsys):
     rendered = trep.render(trep.find_runs(tdir))
     assert "Serving mode" in rendered
     capsys.readouterr()  # swallow loadgen's stdout JSON
+
+
+def test_loadgen_trace_produces_chrome_trace(loadgen_model, tmp_path):
+    """ISSUE 6 acceptance: a traced load run yields a Chrome
+    trace-event JSON in which one request's queue -> batch -> device ->
+    decode spans share a single trace id (with flow events through the
+    batcher flush), and trace_report prints its critical-path
+    breakdown with every phase attributed."""
+    lg = _load_loadgen()
+    cfg, model = loadgen_model
+    from code2vec_tpu.obs import Telemetry
+    tdir = str(tmp_path / "tele")
+    cfg.TRACE = True
+    cfg.SERVE_CACHE_SIZE = 0
+    tele = Telemetry.create(tdir, config=cfg,
+                            component="loadgen").make_threadsafe()
+    server = PredictionServer(cfg, model, telemetry=tele)
+    server.start()
+    try:
+        corpus = [make_raw_lines(1, seed=100 + i) for i in range(16)]
+        rep = lg.run_load(server, corpus, mode="closed", concurrency=4)
+        assert rep["ok"] == 16 and rep["errors"] == 0
+    finally:
+        server.close()
+        cfg.TRACE = False
+    tele.close()
+    from tools.trace_report import (load_spans, request_breakdowns,
+                                    write_chrome_trace)
+    out = str(tmp_path / "trace.json")
+    n = write_chrome_trace([tele.run_dir], out)
+    assert n > 0
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_trace = {}
+    for e in xs:
+        by_trace.setdefault(e["args"].get("trace"), set()).add(e["name"])
+    chain = {"serve/request", "serve/queue_wait", "serve/batch_flush",
+             "serve/device", "serve/decode"}
+    assert any(chain <= names for names in by_trace.values()), \
+        "no request's full chain shares one trace id"
+    assert any(e["ph"] == "s" for e in doc["traceEvents"])
+    (_m, spans), = load_spans([tele.run_dir])
+    rows = request_breakdowns(spans)
+    assert len(rows) == 16
+    for r in rows:
+        for phase in ("queue_wait", "parse", "encode", "device",
+                      "decode"):
+            assert r.get(phase, 0.0) > 0.0, (phase, r)

@@ -1,6 +1,8 @@
-"""TF-free guard (ISSUE 2 satellite): `code2vec_tpu.obs` must import —
-and the disabled + file-backed telemetry paths must run — on an image
-with no TensorFlow at all, and tier-1 test COLLECTION must never pull
+"""TF-free guard (ISSUE 2 satellite; extended for ISSUE 6): all of
+`code2vec_tpu.obs` — telemetry, tracing, the stall watchdog — must
+import and RUN (disabled + file-backed paths, span recording, a
+fake-clock stall with its diagnostic dump) on an image with no
+TensorFlow at all, and tier-1 test COLLECTION must never pull
 TensorFlow in (TF is a tooling dependency, not a training one).
 
 Both tests run subprocesses with a blocker module shadowing
@@ -51,9 +53,35 @@ def test_obs_imports_and_runs_without_tensorflow(tmp_path):
         run = obs.Telemetry.create(d, component="guard")
         run.event("step", step=1, step_ms=1.0, infeed_wait_ms=0.0,
                   loss=0.5)
+
+        # tracing + watchdog (ISSUE 6) ride the same no-TF/no-JAX
+        # constraint: spans record, the fake-clock watchdog fires and
+        # dumps, and both disabled paths are shared no-op singletons
+        tr_off = obs.Tracer.disabled()
+        assert tr_off.start_trace("x") is tr_off.start_span("y")
+        assert obs.Watchdog.disabled().register("z").beat() is None
+        tr = obs.Tracer.create(run)
+        root = tr.start_trace("guard/request")
+        with tr.start_span("guard/phase", parent=root.context()):
+            pass
+        clock = [0.0]
+        wd = obs.Watchdog(run, stall_s=5.0, tracer=tr,
+                          clock=lambda: clock[0])
+        hb = wd.register("guard_component")
+        hb.beat()
+        clock[0] = 6.0
+        assert wd.check_now(), "fake-clock stall did not fire"
+        assert [s["name"] for s in tr.live_spans()] == \
+            ["guard/request"]
+        root.end()
         run.close()
         assert os.path.exists(os.path.join(run.run_dir,
                                            "manifest.json"))
+        with open(os.path.join(run.run_dir, "events.jsonl")) as f:
+            kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        assert "span" in kinds and "stall" in kinds
+        assert any(fn.startswith("stall_dump")
+                   for fn in os.listdir(run.run_dir))
 
         # the ScalarWriter fallback rides the same no-TF constraint
         from code2vec_tpu.training.scalars import ScalarWriter

@@ -63,6 +63,51 @@ def test_timer_stat_ring_keeps_recent_window():
     assert t.count == 72
 
 
+def test_percentile_takes_registry_lock_in_threadsafe_mode():
+    """ISSUE 6 satellite: make_threadsafe() installs the registry lock
+    onto every timer's percentile reads — existing AND later-created —
+    so serving's cross-thread percentile reads can't sort a ring that
+    a concurrent record is mutating (the lock-free path stays
+    lock-free for the single-threaded train loop)."""
+    import threading
+
+    tele = Telemetry.memory("t")
+    before = tele.timer("pre")          # created before the lock
+    assert before._lock is None         # lock-free fast path
+    tele.make_threadsafe()
+    after = tele.timer("post")          # created after
+    assert before._lock is tele._lock is not None
+    assert after._lock is tele._lock
+    # hammer record + percentile concurrently: with the lock this can
+    # never raise or return junk outside the recorded range
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            after.record(float(i % 100))
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                p = after.percentile(99)
+                assert p != p or 0.0 <= p <= 99.0
+        except Exception as e:  # pragma: no cover - the failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    stop.wait(0.3)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errors, errors
+
+
 def test_disabled_is_shared_singleton_and_noop(tmp_path):
     a = Telemetry.create(None)
     assert a is Telemetry.disabled()
@@ -359,3 +404,57 @@ def test_bench_emits_telemetry_events(tmp_path, monkeypatch, capsys):
     assert bench_events[0]["value"] == j["value"]
     assert events[-1]["kind"] == "summary"
     assert events[-1]["gauges"]["bench/ms_per_step"] == j["ms_per_step"]
+
+
+# ---- multi-process merge (--merge, ISSUE 6 satellite) ----
+
+def _fake_process_run(root, idx, count, n_steps, step_ms, run_id):
+    """One per-process run dir: manifest carrying process_index /
+    process_count + step events (the shape Telemetry.create writes)."""
+    d = os.path.join(root, run_id)
+    os.makedirs(d)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({"run_id": run_id, "component": "train",
+                   "process_index": idx, "process_count": count,
+                   "config": {"MAX_CONTEXTS": 10, "ENCODER_TYPE": "bag",
+                              "TABLES_DTYPE": "float32",
+                              "TRAIN_BATCH_SIZE": 4}}, f)
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        for s in range(n_steps):
+            f.write(json.dumps({
+                "kind": "step", "ts": 1000.0 + s, "step": s + 1,
+                "step_ms": step_ms, "infeed_wait_ms": 0.0,
+                "loss": 1.0, "examples": 4}) + "\n")
+    return d
+
+
+def test_report_merge_aggregates_per_process_runs(tmp_path, capsys):
+    """`--merge <dir>...` folds one run dir per process into ONE
+    multi-host table: pc/s summed across processes, step percentiles
+    pooled, per-process rows kept for skew."""
+    report = _load_report_tool()
+    # two processes, process 1 is the 2x-slower straggler
+    d0 = _fake_process_run(str(tmp_path), 0, 2, 10, 10.0, "run-p0")
+    d1 = _fake_process_run(str(tmp_path), 1, 2, 10, 20.0, "run-p1")
+    rc = report.main(["--merge", d0, d1])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "merged(2 runs)" in out
+    assert "| 0/2 |" in out and "| 1/2 |" in out  # skew rows kept
+    # summed throughput: p0 at 10ms/step does 400 ex/s * 10 ctx = 4000
+    # pc/s, p1 half that -> merged 6,000 pc/s
+    assert "6,000" in out, out
+    # without --merge the same dirs render as separate headline rows
+    rc = report.main([d0, d1])
+    out2 = capsys.readouterr().out
+    assert rc == 0 and "merged" not in out2
+    assert out2.count("run-p") >= 2
+
+
+def test_report_merge_warns_on_partial_run_set(tmp_path, capsys):
+    report = _load_report_tool()
+    d0 = _fake_process_run(str(tmp_path), 0, 4, 5, 10.0, "run-p0")
+    rc = report.main(["--merge", d0])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "partial or mixed" in captured.out

@@ -1,0 +1,241 @@
+"""Stall watchdog (ISSUE 6): deadline detection under a FAKE clock (no
+sleeps — tier-1 stays fast), the injected infeed-stall and
+checkpoint-writer-hang scenarios, the diagnostic dump bundle, warn vs
+raise modes, and the disabled path. CPU tier-1."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from code2vec_tpu.obs import StallError, Telemetry, Tracer, Watchdog
+from code2vec_tpu.obs.watchdog import _NULL_HEARTBEAT
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def tele(tmp_path):
+    t = Telemetry.create(str(tmp_path), component="wd").make_threadsafe()
+    yield t
+    t.close()
+
+
+def _stall_events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl"),
+              encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                e = json.loads(line)
+                if e["kind"] == "stall":
+                    out.append(e)
+    return out
+
+
+# ---------------------------------------------------------------------
+# deadline mechanics (fake clock, synchronous check_now)
+# ---------------------------------------------------------------------
+
+def test_stall_fires_after_deadline_and_is_edge_triggered(tele):
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=5.0, clock=fc)
+    hb = wd.register("infeed_producer")
+    assert wd.check_now() == []          # never beaten -> inactive
+    hb.beat()
+    fc.advance(4.9)
+    assert wd.check_now() == []          # within deadline
+    fc.advance(0.2)
+    stalls = wd.check_now()
+    assert [s["component"] for s in stalls] == ["infeed_producer"]
+    assert stalls[0]["age_s"] > 5.0
+    assert wd.check_now() == []          # same episode reported once
+    # a beat BETWEEN two overdue checks still re-arms the episode
+    hb.beat()
+    fc.advance(6.0)
+    assert wd.check_now(), "beat did not re-arm the stall episode"
+    assert tele.counters["watchdog/stalls"] == 2
+
+
+def test_idle_components_are_exempt(tele):
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=1.0, clock=fc)
+    hb = wd.register("checkpoint_writer")
+    hb.busy()
+    hb.idle()                            # job done, nothing in flight
+    fc.advance(100.0)
+    assert wd.check_now() == []
+    hb.busy()                            # next job starts the clock
+    fc.advance(1.5)
+    assert wd.check_now()
+
+
+def test_per_component_deadlines(tele):
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=10.0, clock=fc)
+    fast = wd.register("batcher_consumer", deadline_s=1.0)
+    slow = wd.register("train_loop")     # default 10s
+    fast.beat()
+    slow.beat()
+    fc.advance(2.0)
+    assert [s["component"] for s in wd.check_now()] == \
+        ["batcher_consumer"]
+
+
+def test_stall_event_and_dump_bundle(tele, tmp_path):
+    fc = FakeClock()
+    tracer = Tracer.create(tele)
+    wd = Watchdog(tele, stall_s=2.0, clock=fc, tracer=tracer)
+    hb = wd.register("infeed_producer")
+    hb.beat()
+    live = tracer.start_trace("serve/request", n_methods=3)
+    tele.gauge("serve/queue_depth", 7, emit=False)
+    fc.advance(3.0)
+    stalls = wd.check_now()
+    assert stalls
+    live.end()
+    evs = _stall_events(tele.run_dir)
+    assert evs and evs[0]["component"] == "infeed_producer"
+    dump_path = evs[0]["dump"]
+    assert dump_path and os.path.exists(dump_path)
+    bundle = json.load(open(dump_path, encoding="utf-8"))
+    # the bundle answers "what was in flight": live spans, every
+    # thread's stack, component states, the registry snapshot
+    assert bundle["stalls"][0]["component"] == "infeed_producer"
+    assert [s["name"] for s in bundle["live_spans"]] == \
+        ["serve/request"]
+    assert bundle["threads"], "no thread stacks captured"
+    assert any("test_stall_event_and_dump_bundle" in "".join(frames)
+               for frames in bundle["threads"].values())
+    assert bundle["telemetry"]["gauges"]["serve/queue_depth"] == 7
+    assert bundle["components"]["infeed_producer"]["active"]
+
+
+def test_raise_mode_sticky_at_beat_and_poll(tele):
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=1.0, clock=fc, mode="raise")
+    hb = wd.register("train_loop")
+    hb.beat()
+    fc.advance(2.0)
+    assert wd.check_now()
+    with pytest.raises(StallError):
+        hb.beat()                        # sticky error lands here
+    wd.poll()                            # cleared by the raise
+    # warn mode never raises
+    wd2 = Watchdog(tele, stall_s=1.0, clock=fc, mode="warn")
+    hb2 = wd2.register("x")
+    hb2.beat()
+    fc.advance(2.0)
+    assert wd2.check_now()
+    hb2.beat()
+    wd2.poll()
+    wd2.stop()
+
+
+def test_monitor_thread_runs_and_stops(tele):
+    """Real clock, tiny deadline: the daemon monitor fires without an
+    explicit check_now, and stop() joins it."""
+    wd = Watchdog(tele, stall_s=0.05, check_interval_s=0.02)
+    hb = wd.register("c")
+    hb.beat()
+    wd.start()
+    deadline = time.monotonic() + 5.0
+    while not tele.counters.get("watchdog/stalls") \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert tele.counters.get("watchdog/stalls", 0) >= 1
+
+
+def test_disabled_watchdog_is_shared_noop():
+    wd = Watchdog.disabled()
+    assert wd is Watchdog.disabled() and not wd.enabled
+    hb = wd.register("anything")
+    assert hb is _NULL_HEARTBEAT
+    hb.beat(); hb.busy(); hb.idle()
+    assert wd.start() is wd
+    wd.stop(); wd.poll()
+    assert wd.check_now() == []
+    # memory/disabled telemetry -> the disabled singleton via create()
+    assert Watchdog.create(Telemetry.memory("m"), stall_s=5.0) is wd
+    assert Watchdog.create(None, stall_s=5.0) is wd
+    # stall_s=0 (the flag default) -> disabled too
+    assert Watchdog.create(Telemetry.disabled(), stall_s=0.0) is wd
+
+
+# ---------------------------------------------------------------------
+# injected stalls through the REAL components
+# ---------------------------------------------------------------------
+
+def test_injected_infeed_stall_fires_watchdog(tele):
+    """A producer wedged inside its parse/transfer function (put_fn
+    hangs) stops beating -> stall; a producer merely blocked on a FULL
+    queue keeps beating -> no stall (that indicts the consumer)."""
+    from code2vec_tpu.data.prefetch import prefetch_to_device
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=5.0, clock=fc)
+    hb = wd.register("infeed_producer")
+    wedge = threading.Event()
+    produced = threading.Event()
+
+    def put_fn(b):
+        if b == 1:
+            produced.set()
+            wedge.wait(10)               # the injected stall
+        return b
+
+    infeed = prefetch_to_device([0, 1, 2], put_fn, depth=2)
+    infeed._heartbeat = hb
+    it = iter(infeed)
+    assert next(it)[1] == 0
+    assert produced.wait(5)              # producer entered the wedge
+    fc.advance(6.0)
+    stalls = wd.check_now()
+    assert [s["component"] for s in stalls] == ["infeed_producer"]
+    wedge.set()                          # release; drain cleanly
+    assert [b for _, b in it] == [1, 2]
+    fc.advance(6.0)
+    assert wd.check_now() == [], \
+        "finished producer must go idle, not stall"
+
+
+def test_injected_writer_hang_fires_watchdog(tele, tmp_path):
+    """An async checkpoint save hung in serialization (save_fn blocks)
+    stops the writer's heartbeat -> stall with the writer thread's
+    stack in the dump; an idle writer is exempt."""
+    from code2vec_tpu.training.checkpoint import AsyncCheckpointWriter
+    fc = FakeClock()
+    wd = Watchdog(tele, stall_s=5.0, clock=fc)
+    hb = wd.register("checkpoint_writer")
+    hang = threading.Event()
+    entered = threading.Event()
+
+    def stuck_save(ckpt_dir, state, step, vocabs, dims,
+                   extra_manifest=None, max_to_keep=10):
+        entered.set()
+        hang.wait(10)
+
+    writer = AsyncCheckpointWriter(save_fn=stuck_save, heartbeat=hb)
+    writer.submit(str(tmp_path / "ckpt"), {"step": 1}, 1, None, None)
+    assert entered.wait(5)
+    fc.advance(6.0)
+    stalls = wd.check_now()
+    assert [s["component"] for s in stalls] == ["checkpoint_writer"]
+    dump = json.load(open(_stall_events(tele.run_dir)[0]["dump"],
+                          encoding="utf-8"))
+    assert any("ckpt-writer" in label for label in dump["threads"])
+    hang.set()
+    writer.close()
+    fc.advance(6.0)
+    assert wd.check_now() == [], "idle writer must be exempt"

@@ -122,7 +122,8 @@ def _read_events(run_dir: str) -> List[Dict[str, Any]]:
 
 def run_mode(prefix: str, workdir: str, *, use_async: bool, epochs: int,
              batch: int, emb: int, max_contexts: int,
-             with_eval: bool) -> List[Dict[str, Any]]:
+             with_eval: bool, trace: bool = False,
+             trace_out: Optional[str] = None) -> List[Dict[str, Any]]:
     from code2vec_tpu.config import Config
     from code2vec_tpu.models.jax_model import Code2VecModel
     tag = "async" if use_async else "sync"
@@ -132,7 +133,7 @@ def run_mode(prefix: str, workdir: str, *, use_async: bool, epochs: int,
         DEFAULT_EMBEDDINGS_SIZE=emb, TRAIN_BATCH_SIZE=batch,
         TEST_BATCH_SIZE=batch, NUM_TRAIN_EPOCHS=epochs,
         SAVE_EVERY_EPOCHS=1, NUM_BATCHES_TO_LOG_PROGRESS=10_000,
-        USE_BF16=False, ASYNC_CHECKPOINT=use_async,
+        USE_BF16=False, ASYNC_CHECKPOINT=use_async, TRACE=trace,
         TELEMETRY_DIR=os.path.join(workdir, f"tele_{tag}"))
     cfg.train_data_path = prefix
     if with_eval:
@@ -141,6 +142,14 @@ def run_mode(prefix: str, workdir: str, *, use_async: bool, epochs: int,
     model = Code2VecModel(cfg)
     model.train()
     model.close_session()
+    if trace and trace_out:
+        # Chrome trace of the boundary: step_cycle spans on the loop
+        # row, save_write on the ckpt-writer row, infeed/produce on the
+        # producer row — the overlap the summary numbers claim, visible
+        from tools.trace_report import write_chrome_trace
+        n = write_chrome_trace([model.telemetry.run_dir], trace_out)
+        print(json.dumps({"trace_json": trace_out, "mode": tag,
+                          "trace_events": n}), flush=True)
     return analyze(_read_events(model.telemetry.run_dir))
 
 
@@ -162,6 +171,10 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Any]:
                     help="skip the per-epoch eval (isolates the save "
                          "overlap: next-epoch steps run during the "
                          "writer drain instead of eval)")
+    ap.add_argument("--trace", action="store_true",
+                    help="per-step span trees (--trace) for both "
+                         "runs; writes epoch_overhead_trace_{sync,"
+                         "async}.json Chrome traces to the cwd")
     ap.add_argument("--out", default=None, help="also append JSONL here")
     a = ap.parse_args(argv)
 
@@ -172,7 +185,9 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Any]:
             rows = run_mode(prefix, wd, use_async=use_async,
                             epochs=a.epochs, batch=a.batch, emb=a.emb,
                             max_contexts=a.max_contexts,
-                            with_eval=not a.no_eval)
+                            with_eval=not a.no_eval, trace=a.trace,
+                            trace_out=(f"epoch_overhead_trace_{tag}"
+                                       ".json") if a.trace else None)
             result[tag] = rows
             for r in rows:
                 print(json.dumps({"mode": tag, **r}), flush=True)

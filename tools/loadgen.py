@@ -262,14 +262,35 @@ def main(argv=None) -> int:
                     help="0 (default) keeps throughput numbers honest "
                          "on a repeating corpus")
     ap.add_argument("--telemetry_dir", default=None)
+    ap.add_argument("--trace", action="store_true",
+                    help="request-scoped tracing: queue -> batch -> "
+                         "device -> decode span trees per request; "
+                         "exports Chrome trace JSON after the run "
+                         "(defaults --telemetry_dir to a temp dir "
+                         "when unset)")
+    ap.add_argument("--trace_out", default=None,
+                    help="Chrome trace JSON path (default: "
+                         "<run_dir>/trace.json)")
+    ap.add_argument("--watchdog_stall_s", type=float, default=0.0,
+                    help="stall watchdog deadline for the batcher "
+                         "consumer (0 = off)")
+    ap.add_argument("--watchdog_mode", default="warn",
+                    choices=["warn", "raise"])
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args(argv)
     if args.load and args.synthetic:
         ap.error("--load and --synthetic are mutually exclusive")
+    if (args.trace or args.watchdog_stall_s > 0) \
+            and not args.telemetry_dir:
+        # spans and stall dumps live in the run dir — make one
+        args.telemetry_dir = tempfile.mkdtemp(prefix="loadgen_trace_")
 
     cfg, model = _build_model(args)
     if args.telemetry_dir:
         cfg.TELEMETRY_DIR = args.telemetry_dir
+    cfg.TRACE = bool(args.trace)
+    cfg.WATCHDOG_STALL_S = args.watchdog_stall_s
+    cfg.WATCHDOG_MODE = args.watchdog_mode
 
     if args.corpus:
         with open(args.corpus, encoding="utf-8") as f:
@@ -324,6 +345,17 @@ def main(argv=None) -> int:
     for rep in reports:
         tele.event("loadgen", **rep)
     tele.close()
+    if args.trace and tele.run_dir:
+        # export the run's spans as Chrome trace-event JSON (Perfetto /
+        # chrome://tracing; tools/trace_report.py prints the
+        # critical-path breakdown from the same run dir)
+        from tools.trace_report import write_chrome_trace
+        trace_out = args.trace_out or os.path.join(tele.run_dir,
+                                                   "trace.json")
+        n_events = write_chrome_trace([tele.run_dir], trace_out)
+        out["trace_json"] = trace_out
+        out["trace_events"] = n_events
+        out["trace_run_dir"] = tele.run_dir
     text = json.dumps(out, indent=2)
     print(text)
     if args.out:

@@ -309,11 +309,78 @@ def render(run_dirs: List[str]) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
+def render_merged(run_dirs: List[str]) -> str:
+    """`--merge`: treat the given run dirs as ONE logical multi-process
+    run (one per host, the manifests carrying process_index /
+    process_count — ROADMAP item 2's MULTICHIP reporting shape) and
+    aggregate them into a single headline row: global throughput is the
+    SUM of per-process pc/s (each host feeds its own shard), step
+    latency percentiles pool every process's step samples, and the
+    per-process rows below keep the skew visible (a straggler host
+    shows up as a slow row, not a hidden average)."""
+    loaded = [(d, *load_run(d)) for d in run_dirs]
+    rows = []
+    for d, m, ev in loaded:
+        s = summarize_steps(m, ev)
+        if s is None:
+            print(f"warning: {d} has no step events; skipped from "
+                  "merge", file=sys.stderr)
+            continue
+        rows.append((m, s))
+    if not rows:
+        return "(no runs with step events to merge)\n"
+    counts = {m.get("process_count", 1) for m, _ in rows}
+    lines: List[str] = []
+    if len(counts) > 1 or len(rows) != max(counts):
+        lines.append(f"warning: merging {len(rows)} run(s) whose "
+                     f"manifests declare process_count {sorted(counts)}"
+                     " — partial or mixed run set")
+        lines.append("")
+    rows.sort(key=lambda r: r[0].get("process_index", 0))
+    all_step_ms = [ms for _, s in rows for ms in s["step_ms"]]
+    all_wait_ms = [ms for _, s in rows for ms in s["infeed_wait_ms"]]
+    total_pc = sum(s["pc_per_sec"] for _, s in rows
+                   if s["pc_per_sec"] == s["pc_per_sec"])
+    lines.append("| Config | procs | ms/step | pc/s (sum) "
+                 "| vs V100 (1.94M) | infeed wait p95 (ms) | steps "
+                 "| Source |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    m0 = rows[0][0]
+    lines.append(
+        f"| {_config_label(m0)} | {len(rows)} "
+        f"| {_fmt(_pct(all_step_ms, 50))} "
+        f"| {_fmt(total_pc, 1)} "
+        f"| {_fmt(total_pc / _v100_denominator(), 3)} "
+        f"| {_fmt(_pct(all_wait_ms, 95))} "
+        f"| {max(s['n_steps'] for _, s in rows)} "
+        f"| merged({len(rows)} runs) |")
+    lines.append("")
+    lines.append("| Process | steps | examples | ex/s | pc/s "
+                 "| ms/step p50 | infeed p95 | run |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    for m, s in rows:
+        lines.append(
+            f"| {m.get('process_index', 0)}"
+            f"/{m.get('process_count', 1)} "
+            f"| {s['n_steps']} | {s['examples']} "
+            f"| {_fmt(s['ex_per_sec'], 1)} "
+            f"| {_fmt(s['pc_per_sec'], 1)} "
+            f"| {_fmt(s['ms_per_step_p50'])} "
+            f"| {_fmt(_pct(s['infeed_wait_ms'], 95))} "
+            f"| {m.get('run_id', '?')} |")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize code2vec_tpu telemetry JSONL runs")
     ap.add_argument("paths", nargs="+",
                     help="telemetry root dir(s) or run dir(s)")
+    ap.add_argument("--merge", action="store_true",
+                    help="aggregate the given per-process run dirs "
+                         "into ONE multi-host table (pc/s summed, "
+                         "step percentiles pooled, per-process skew "
+                         "rows below)")
     args = ap.parse_args(argv)
     run_dirs: List[str] = []
     for p in args.paths:
@@ -323,6 +390,9 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         run_dirs.extend(found)
+    if args.merge:
+        sys.stdout.write(render_merged(run_dirs))
+        return 0
     sys.stdout.write(render(run_dirs))
     return 0
 
