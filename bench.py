@@ -303,14 +303,51 @@ def main(argv=None) -> None:
                     help="also emit the measurements as telemetry "
                          "events (code2vec_tpu/obs): BENCH rounds and "
                          "train runs share one JSONL format")
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="serve /metrics //healthz //vars while the "
+                         "benchmark runs (phase results appear as "
+                         "bench/* gauges the moment each phase "
+                         "lands); 0 = off")
     args = ap.parse_args(argv if argv is not None else [])
+    from code2vec_tpu.obs import MetricsServer, Telemetry
+    if args.telemetry_dir:
+        tele = Telemetry.create(args.telemetry_dir, component="bench")
+    elif args.metrics_port:
+        # live scrape without persistence: the registry lives in
+        # memory, /metrics serves it
+        tele = Telemetry.memory("bench")
+    else:
+        tele = Telemetry.disabled()
+    metrics_server = MetricsServer.create(
+        tele.make_threadsafe() if tele.enabled else tele,
+        port=args.metrics_port)
+    metrics_server.start()
+
+    def _live(**kv) -> None:
+        # publish each phase's numbers the moment they land, so a
+        # scraper watching --metrics_port sees progress mid-benchmark
+        # (static: phase results are set-once facts, not heartbeats —
+        # they must not read as stale while later phases run)
+        for k, v in kv.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                tele.gauge(f"bench/{k}", v, emit=False, static=True)
+
     ceiling = _measure_hbm_ceiling()
+    _live(hbm_ceiling_gbps=ceiling / 1e9, phases_done=1)
     value, ms, hbm_gbps = _measure_encoder("bag")
+    _live(value=value, ms_per_step=ms, hbm_gbps=hbm_gbps,
+          phases_done=2)
     floor = _measure_fwd_bwd_floor()
+    _live(fwd_bwd_floor_pc_per_sec=floor, phases_done=3)
     i8_value, i8_ms, i8_hbm = _measure_encoder("bag", tables_dtype="int8")
+    _live(int8_pc_per_sec=i8_value, int8_ms_per_step=i8_ms,
+          phases_done=4)
     rq_ms, rq_bytes, rq_fused = _measure_requant_phase()
     rq_gbps = rq_bytes / (rq_ms / 1e3) / 1e9
+    _live(int8_requant_ms=rq_ms, phases_done=5)
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
+    _live(transformer_pc_per_sec=xf_value,
+          transformer_ms_per_step=xf_ms, phases_done=6)
     result = {
         "metric": "path-contexts/sec/chip",
         "value": round(value, 1),
@@ -360,13 +397,13 @@ def main(argv=None) -> None:
         "transformer_vs_baseline": round(
             xf_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
     }
-    if args.telemetry_dir:
-        from code2vec_tpu.obs import Telemetry
-        tele = Telemetry.create(args.telemetry_dir, component="bench")
+    if tele.enabled:
         tele.event("bench", **result)
         for k, v in result.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 tele.gauge(f"bench/{k}", v, emit=False)
+    metrics_server.stop()
+    if tele.enabled:
         tele.close()
     print(json.dumps(result))
 

@@ -38,6 +38,9 @@ CONFIG_CONSTANTS = frozenset({
     "NUM_BATCHES_TO_LOG_PROGRESS",
     "TOP_K_WORDS_CONSIDERED_DURING_PREDICTION",
     "PROFILE_START_STEP",        # --profile_steps is the user knob
+    "HEALTH_EVERY_S",            # monitor cadence; tests inject tiny
+    #                              values directly, production default
+    #                              is deliberately not a tuning knob
 })
 
 
@@ -292,6 +295,33 @@ class Config:
     # silent wedge).
     WATCHDOG_MODE: str = "warn"
 
+    # ---- live metrics plane (code2vec_tpu/obs/exposition.py +
+    # health.py + alerts.py, ISSUE 7): pull-based exposition, derived
+    # health monitors, and an SLO alert engine. ----
+    # --metrics_port: serve /metrics (Prometheus text format),
+    # /healthz (watchdog-liveness readiness) and /vars (raw JSON
+    # snapshot) from a stdlib daemon-thread HTTP server on this port.
+    # 0 (default) = off. Works without --telemetry_dir (the registry
+    # then lives in memory only — live scrape, no JSONL persistence).
+    METRICS_PORT: int = 0
+    # --alerts_mode: "off" (default) | "warn" | "raise". warn/raise
+    # start the health monitors (non-finite loss, loss-spike z-score,
+    # throughput regression, infeed starvation; serving adds cache-hit
+    # collapse + shed burn-rate) and evaluate alert rules on a cadence
+    # off the hot path, emitting edge-triggered `alert` JSONL events +
+    # stdout lines. "raise" additionally makes a firing alert sticky —
+    # AlertError at the training loop's next beat (the watchdog's
+    # sticky-error discipline; never raised from the monitor thread).
+    ALERTS_MODE: str = "off"
+    # --alerts_rules: JSON file replacing the built-in rule set (see
+    # README "Live metrics & alerts" for the syntax); None = defaults.
+    ALERTS_RULES: Optional[str] = None
+    # health-monitor / alert-rule evaluation cadence in seconds (no
+    # CLI flag by design: tests inject tiny values, production runs
+    # are fine at 1 Hz — the monitors read dict snapshots, so the
+    # sweep never touches the hot path either way).
+    HEALTH_EVERY_S: float = 1.0
+
     # ---- adversarial attacks (the noamyft fork delta, SURVEY.md §0
     # item 2; attacks/): --attack {targeted,untargeted} runs the
     # gradient-guided rename attack on --attack_input's source and
@@ -521,6 +551,26 @@ class Config:
                        help="on a missed deadline: warn (record + "
                             "dump diagnostics, keep running) or raise "
                             "(sticky StallError)")
+        p.add_argument("--metrics_port", dest="metrics_port",
+                       type=int, default=None,
+                       help="serve /metrics (Prometheus text), "
+                            "/healthz (watchdog liveness) and /vars "
+                            "(JSON snapshot) on this port from a "
+                            "daemon-thread HTTP server (0 = off; "
+                            "works with or without --telemetry_dir)")
+        p.add_argument("--alerts_mode", dest="alerts_mode",
+                       default=None, choices=["off", "warn", "raise"],
+                       help="training-health monitors + SLO alert "
+                            "rules evaluated off the hot path: warn "
+                            "records edge-triggered alert events, "
+                            "raise additionally surfaces a sticky "
+                            "AlertError at the train loop's next beat "
+                            "(requires --telemetry_dir)")
+        p.add_argument("--alerts_rules", dest="alerts_rules",
+                       default=None,
+                       help="JSON rule file replacing the built-in "
+                            "alert rules (threshold + multi-window "
+                            "burn-rate; see README)")
         p.add_argument("--serve_batch_max", dest="serve_batch_max",
                        type=int, default=None,
                        help="max methods per coalesced serving batch "
@@ -684,6 +734,12 @@ class Config:
             cfg.WATCHDOG_STALL_S = ns.watchdog_stall_s
         if ns.watchdog_mode is not None:
             cfg.WATCHDOG_MODE = ns.watchdog_mode
+        if ns.metrics_port is not None:
+            cfg.METRICS_PORT = ns.metrics_port
+        if ns.alerts_mode is not None:
+            cfg.ALERTS_MODE = ns.alerts_mode
+        if ns.alerts_rules is not None:
+            cfg.ALERTS_RULES = ns.alerts_rules
         if ns.serve_batch_max is not None:
             cfg.SERVE_BATCH_MAX = ns.serve_batch_max
         if ns.serve_batch_timeout_ms is not None:
@@ -824,6 +880,25 @@ class Config:
             raise ValueError(
                 "--watchdog_mode must be warn or raise "
                 f"(got {self.WATCHDOG_MODE!r}).")
+        if not 0 <= self.METRICS_PORT <= 65535:
+            raise ValueError(
+                f"--metrics_port must be in [0, 65535] "
+                f"(got {self.METRICS_PORT}).")
+        if self.ALERTS_MODE not in ("off", "warn", "raise"):
+            raise ValueError(
+                "--alerts_mode must be off, warn or raise "
+                f"(got {self.ALERTS_MODE!r}).")
+        if self.ALERTS_MODE != "off" and not self.TELEMETRY_DIR:
+            raise ValueError(
+                "--alerts_mode warn/raise requires --telemetry_dir "
+                "(alert events are recorded through the run's JSONL "
+                "event log; --metrics_port alone works without it).")
+        if self.ALERTS_RULES and self.ALERTS_MODE == "off":
+            raise ValueError(
+                "--alerts_rules without --alerts_mode warn|raise "
+                "would be silently ignored.")
+        if self.HEALTH_EVERY_S <= 0:
+            raise ValueError("HEALTH_EVERY_S must be positive.")
         if self.LR_WARMUP_STEPS < 0:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:

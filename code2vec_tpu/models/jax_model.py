@@ -337,15 +337,24 @@ class Code2VecModel(Code2VecModelBase):
         # per step (recorder.enabled) and wrap() returns the infeed
         # unchanged.
         from code2vec_tpu.obs import (SpanChannel, Telemetry, Tracer,
-                                      TrainStepRecorder, Watchdog)
+                                      TrainStepRecorder, Watchdog,
+                                      build_live_plane)
         telemetry = Telemetry.create(
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", scalar_writer=scalars, log=self.log)
+        if cfg.METRICS_PORT > 0 and not telemetry.enabled:
+            # --metrics_port without --telemetry_dir: live pull-based
+            # exposition over an in-memory registry (scrapeable run,
+            # no JSONL persistence; per-step recording — and its
+            # documented device-sync trade — applies either way)
+            telemetry = Telemetry.memory("train")
         self.telemetry = telemetry
-        if cfg.ASYNC_CHECKPOINT or cfg.TRACE or cfg.WATCHDOG_STALL_S > 0:
-            # the checkpoint writer, the infeed producer (trace spans)
-            # and the watchdog monitor all record into this registry
-            # from their own threads
+        live_plane = cfg.METRICS_PORT > 0 or cfg.ALERTS_MODE != "off"
+        if (cfg.ASYNC_CHECKPOINT or cfg.TRACE
+                or cfg.WATCHDOG_STALL_S > 0 or live_plane):
+            # the checkpoint writer, the infeed producer (trace spans),
+            # the watchdog/health monitors and the exposition handler
+            # all record into / read this registry from other threads
             telemetry.make_threadsafe()
         # request-scoped tracing (--trace) + stall watchdog
         # (--watchdog_stall_s): per-step span trees linking the infeed
@@ -360,13 +369,35 @@ class Code2VecModel(Code2VecModelBase):
             mode=cfg.WATCHDOG_MODE, tracer=tracer, log=self.log)
         loop_hb = watchdog.register("train_loop")
         self._ckpt_heartbeat = watchdog.register("checkpoint_writer")
+        # live metrics plane (ISSUE 7): health monitors + alert rules
+        # swept on a cadence thread OFF the hot path, and the
+        # /metrics //healthz //vars exposition server — one shared
+        # wiring (obs/exposition.build_live_plane); no-op singletons
+        # when the flags are off.
+        from code2vec_tpu.obs.alerts import default_train_rules
+        from code2vec_tpu.obs.health import default_train_monitors
+        plane = build_live_plane(
+            telemetry, metrics_port=cfg.METRICS_PORT,
+            alerts_mode=cfg.ALERTS_MODE,
+            alerts_rules=cfg.ALERTS_RULES,
+            health_every_s=cfg.HEALTH_EVERY_S, watchdog=watchdog,
+            monitors=default_train_monitors(),
+            default_rules=default_train_rules, log=self.log)
+        alerts = plane.alerts
+        self.metrics_server = plane.metrics
         infeed_channel = SpanChannel() if tracer.enabled else None
         recorder = TrainStepRecorder(
             telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS,
             tracer=tracer, infeed_channel=infeed_channel,
-            heartbeat=loop_hb if watchdog.enabled else None)
+            heartbeat=loop_hb if watchdog.enabled else None,
+            alerts=alerts if alerts.enabled else None)
         self._trace_recorder = recorder
         watchdog.start()
+        plane.start()
+        # tools/obs_top.py derives pc/s = examples-rate x this gauge
+        # (static: a set-once config echo must not read as stale)
+        telemetry.gauge("train/max_contexts", cfg.MAX_CONTEXTS,
+                        emit=False, static=True)
         loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
@@ -453,9 +484,11 @@ class Code2VecModel(Code2VecModelBase):
                 # background write failure)
                 self._ckpt_writer.wait()
             watchdog.poll()  # raise-mode: a stalled run dies loudly here
+            alerts.poll()    # raise-mode: so does a firing alert
         finally:
             loop_hb.idle()
             watchdog.stop()  # no re-raise: must not mask loop errors
+            plane.stop()
             if self._ckpt_writer is not None:
                 # exception-path teardown: drain without
                 # masking the in-flight error (a sticky

@@ -162,14 +162,22 @@ class VarMisuseModel:
         # step_ms/infeed_wait_ms/loss records as the code2vec head; the
         # shared recorder keeps the two loops' metrics comparable.
         from code2vec_tpu.obs import (SpanChannel, Telemetry, Tracer,
-                                      TrainStepRecorder, Watchdog)
+                                      TrainStepRecorder, Watchdog,
+                                      build_live_plane)
         telemetry = Telemetry.create(
             cfg.TELEMETRY_DIR, config=cfg, mesh=self.mesh,
             component="train", log=self.log)
+        if cfg.METRICS_PORT > 0 and not telemetry.enabled:
+            # --metrics_port without --telemetry_dir: live exposition
+            # over an in-memory registry (same as jax_model)
+            telemetry = Telemetry.memory("train")
         self.telemetry = telemetry
-        if cfg.ASYNC_CHECKPOINT or cfg.TRACE or cfg.WATCHDOG_STALL_S > 0:
-            # the checkpoint writer, the infeed producer (trace spans)
-            # and the watchdog monitor all record cross-thread
+        live_plane = cfg.METRICS_PORT > 0 or cfg.ALERTS_MODE != "off"
+        if (cfg.ASYNC_CHECKPOINT or cfg.TRACE
+                or cfg.WATCHDOG_STALL_S > 0 or live_plane):
+            # the checkpoint writer, the infeed producer (trace spans),
+            # the watchdog/health monitors and the exposition handler
+            # all touch this registry cross-thread
             telemetry.make_threadsafe()
         # per-step tracing + stall watchdog — same wiring as jax_model
         # (shared recorder/obs layer keeps the two loops comparable)
@@ -182,13 +190,30 @@ class VarMisuseModel:
         loop_hb = watchdog.register("train_loop")
         self._ckpt_heartbeat = watchdog.register("checkpoint_writer")
         infeed_hb = watchdog.register("infeed_producer")
+        # live metrics plane (ISSUE 7) — the ONE shared wiring
+        # (obs/exposition.build_live_plane), same as jax_model
+        from code2vec_tpu.obs.alerts import default_train_rules
+        from code2vec_tpu.obs.health import default_train_monitors
+        plane = build_live_plane(
+            telemetry, metrics_port=cfg.METRICS_PORT,
+            alerts_mode=cfg.ALERTS_MODE,
+            alerts_rules=cfg.ALERTS_RULES,
+            health_every_s=cfg.HEALTH_EVERY_S, watchdog=watchdog,
+            monitors=default_train_monitors(),
+            default_rules=default_train_rules, log=self.log)
+        alerts = plane.alerts
+        self.metrics_server = plane.metrics
         infeed_channel = SpanChannel() if tracer.enabled else None
         recorder = TrainStepRecorder(
             telemetry, gauge_every=cfg.NUM_BATCHES_TO_LOG_PROGRESS,
             tracer=tracer, infeed_channel=infeed_channel,
-            heartbeat=loop_hb if watchdog.enabled else None)
+            heartbeat=loop_hb if watchdog.enabled else None,
+            alerts=alerts if alerts.enabled else None)
         self._trace_recorder = recorder
         watchdog.start()
+        plane.start()
+        telemetry.gauge("train/max_contexts", cfg.MAX_CONTEXTS,
+                        emit=False, static=True)
         loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         from code2vec_tpu.data.prefetch import (build_train_infeed,
@@ -252,9 +277,11 @@ class VarMisuseModel:
                 # background write failure)
                 self._ckpt_writer.wait()
             watchdog.poll()  # raise-mode: a stalled run dies loudly here
+            alerts.poll()    # raise-mode: so does a firing alert
         finally:
             loop_hb.idle()
             watchdog.stop()  # no re-raise: must not mask loop errors
+            plane.stop()
             if self._ckpt_writer is not None:
                 # exception-path teardown: drain without
                 # masking the in-flight error (a sticky

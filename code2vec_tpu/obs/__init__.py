@@ -8,6 +8,13 @@ model heads share. Stdlib-only at import time — jax is lazy, TensorFlow
 is never imported here (guard: tests/test_obs_guard.py).
 """
 
+from code2vec_tpu.obs.alerts import (AlertEngine, AlertError,  # noqa: F401
+                                     AlertRule, load_rules)
+from code2vec_tpu.obs.exposition import (LivePlane,  # noqa: F401
+                                         MetricsServer,
+                                         build_live_plane,
+                                         render_prometheus)
+from code2vec_tpu.obs.health import HealthEngine  # noqa: F401
 from code2vec_tpu.obs.loop import (TrainStepRecorder,  # noqa: F401
                                    infeed_produce_instrument)
 from code2vec_tpu.obs.sinks import (JsonlSink, ScalarSink,  # noqa: F401

@@ -85,12 +85,15 @@ class TrainStepRecorder:
     def __init__(self, telemetry: Telemetry, gauge_every: int = 100,
                  tracer: Optional[Tracer] = None,
                  infeed_channel: Optional[SpanChannel] = None,
-                 heartbeat=None):
+                 heartbeat=None, alerts=None):
         self.enabled = telemetry.enabled
         self._tele = telemetry
         self._tracer = tracer if tracer is not None else Tracer.disabled()
         self._channel = infeed_channel
         self._heartbeat = heartbeat
+        # alert engine (obs/alerts.py): end_step is "the training
+        # loop's next beat" where a raise-mode sticky alert surfaces
+        self._alerts = alerts
         self.last_step_context: Optional[SpanContext] = None
         self._gauge_every = max(1, gauge_every)
         self._steps = 0
@@ -129,11 +132,18 @@ class TrainStepRecorder:
         tele.record_ms("train/infeed_wait_ms", self._infeed_wait_ms)
         tele.count("train/steps")
         tele.count("train/examples", int(n_examples))
+        # live-plane feed (obs/health.py): the newest loss as a gauge
+        # so the non-finite / spike monitors can read it off the hot
+        # path (emit=False: a dict store, never a JSONL event)
+        tele.gauge("train/loss", loss_f, emit=False)
         tele.event("step", step=int(step), step_ms=round(step_ms, 3),
                    infeed_wait_ms=round(self._infeed_wait_ms, 3),
                    loss=round(loss_f, 6), examples=int(n_examples))
         if self._heartbeat is not None:
             self._heartbeat.beat()
+        alerts = self._alerts
+        if alerts is not None and alerts._sticky is not None:
+            alerts.poll()  # raise-mode alert lands at the loop's beat
         if self._tracer.enabled:
             self._trace_step(step, step_ms, n_examples)
         self._steps += 1

@@ -226,6 +226,11 @@ class Telemetry:
         self.sinks = list(sinks)
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
+        # last-update time (time.monotonic) per gauge: a dead producer's
+        # queue-depth gauge must not read as a live value forever —
+        # /metrics and the watchdog's stall dump mark stale gauges from
+        # these timestamps (gauge_ages()).
+        self.gauge_updated: Dict[str, float] = {}
         self.timers: Dict[str, TimerStat] = {}
         # None = lock-free fast path (the train loop); serving calls
         # make_threadsafe() because many threads share one registry
@@ -297,11 +302,34 @@ class Telemetry:
         with self._guard():
             self.counters[name] = self.counters.get(name, 0) + n
 
-    def gauge(self, name: str, value: float, emit: bool = True) -> None:
+    def gauge(self, name: str, value: float, emit: bool = True,
+              static: bool = False) -> None:
+        """`static=True` marks a set-once constant (a config echo like
+        train/max_contexts): freshness is meaningless for it, so it is
+        excluded from gauge_ages() — otherwise every staleness
+        consumer (/metrics ages, obs_top, stall dumps) would flag it
+        forever and bury the real dead-producer signal."""
         with self._guard():
             self.gauges[name] = value
+            if static:
+                self.gauge_updated.pop(name, None)
+            else:
+                self.gauge_updated[name] = time.monotonic()
         if emit:
             self.event("gauge", name=name, value=value)
+
+    def gauge_ages(self, now: Optional[float] = None
+                   ) -> Dict[str, float]:
+        """Seconds since each gauge was last set (time.monotonic
+        timebase). The freshness signal for pull-based consumers: a
+        queue-depth gauge whose producer died keeps its last VALUE, but
+        its age keeps growing — /metrics exposes these so a scraper
+        can mark the gauge stale, and the watchdog's stall dump lists
+        gauges older than the stall deadline."""
+        t = time.monotonic() if now is None else now
+        with self._guard():
+            return {name: max(0.0, t - ts)
+                    for name, ts in self.gauge_updated.items()}
 
     def timer(self, name: str) -> TimerStat:
         with self._guard():
@@ -375,7 +403,7 @@ class _NullTelemetry(Telemetry):
     def count(self, name, n=1):
         pass
 
-    def gauge(self, name, value, emit=True):
+    def gauge(self, name, value, emit=True, static=False):
         pass
 
     def timer(self, name):

@@ -141,6 +141,9 @@ class Watchdog:
         self._sticky: Optional[StallError] = None
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # attached via attach(): their state tables join the stall dump
+        self._health = None
+        self._alerts = None
 
     # ---- construction ----
     @classmethod
@@ -161,6 +164,32 @@ class Watchdog:
         with self._lock:
             self._components[name] = hb
         return hb
+
+    def attach(self, health=None, alerts=None) -> "Watchdog":
+        """Attach the health-monitor / alert engines (ISSUE 7) so a
+        stall dump carries their state tables: one bundle answers both
+        "what is stuck" and "what was already unhealthy"."""
+        if health is not None:
+            self._health = health
+        if alerts is not None:
+            self._alerts = alerts
+        return self
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        """Live per-component liveness, recomputed from the heartbeat
+        table NOW (not the edge-trigger memory): what /healthz gates
+        on. `stalled` = active and past its deadline at this instant.
+        """
+        now = self._clock()
+        with self._lock:
+            return {
+                name: {"active": hb._active,
+                       "deadline_s": hb.deadline_s,
+                       "age_s": round(max(0.0, now - hb._last), 3),
+                       "stalled": bool(hb._active
+                                       and now - hb._last
+                                       > hb.deadline_s)}
+                for name, hb in self._components.items()}
 
     # ---- monitoring ----
     def start(self) -> "Watchdog":
@@ -264,6 +293,12 @@ class Watchdog:
                        "last_beat_age_s": round(
                            self._clock() - hb._last, 3)}
                 for name, hb in self._components.items()}
+        # stale gauges: a dead producer's gauge keeps its last VALUE;
+        # age past the stall deadline marks it untrustworthy in the
+        # same bundle that shows which component went quiet (ages ride
+        # the registry's own monotonic timestamps, not the watchdog's
+        # injectable clock)
+        gauge_ages = self.telemetry.gauge_ages()
         bundle = {
             "ts": time.time(),
             "stalls": stalls,
@@ -272,6 +307,19 @@ class Watchdog:
                            if self.tracer is not None else []),
             "threads": self._thread_stacks(),
             "telemetry": self.telemetry.summary(),
+            "gauge_age_s": {k: round(v, 3)
+                            for k, v in gauge_ages.items()},
+            "stale_gauges": sorted(
+                k for k, v in gauge_ages.items()
+                if v > self.default_stall_s),
+            # what was already unhealthy BEFORE the stall (ISSUE 7):
+            # the health-monitor + alert-state tables, when attached
+            "health": (self._health.status_table()
+                       if self._health is not None
+                       and self._health.enabled else []),
+            "alerts": (self._alerts.status_table()
+                       if self._alerts is not None
+                       and self._alerts.enabled else []),
         }
         if run_dir is None:
             return None
@@ -294,9 +342,17 @@ class _NullWatchdog(Watchdog):
         self.tracer = None
         self.mode = "warn"
         self._sticky = None
+        self._health = None
+        self._alerts = None
 
     def register(self, name, deadline_s=None):
         return _NULL_HEARTBEAT
+
+    def attach(self, health=None, alerts=None):
+        return self
+
+    def status(self):
+        return {}
 
     def start(self):
         return self

@@ -45,7 +45,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from code2vec_tpu.common import MethodPredictionResults
 from code2vec_tpu.config import Config
-from code2vec_tpu.obs import Telemetry, Tracer, Watchdog
+from code2vec_tpu.obs import (Telemetry, Tracer, Watchdog,
+                              build_live_plane)
 from code2vec_tpu.serving.batcher import (MicroBatcher, PredictRequest,
                                           ServerOverloaded)
 from code2vec_tpu.serving.extractor import ExtractorPool
@@ -136,6 +137,24 @@ class PredictionServer:
                 tracer=tracer, log=getattr(config, "log", None))
         self.watchdog = watchdog
         self._batcher_hb = watchdog.register("batcher_consumer")
+        # live metrics plane (ISSUE 7): /metrics //healthz //vars over
+        # the serving registry (readiness gates on the batcher's
+        # heartbeat), plus the serving health monitors (cache-hit
+        # collapse, shed rate) and alert rules on a cadence thread —
+        # the shared wiring; all no-op singletons with the flags off.
+        from code2vec_tpu.obs.alerts import default_serving_rules
+        from code2vec_tpu.obs.health import default_serving_monitors
+        self._live_plane = build_live_plane(
+            tele, metrics_port=getattr(config, "METRICS_PORT", 0),
+            alerts_mode=getattr(config, "ALERTS_MODE", "off"),
+            alerts_rules=getattr(config, "ALERTS_RULES", None),
+            health_every_s=getattr(config, "HEALTH_EVERY_S", 1.0),
+            watchdog=watchdog, monitors=default_serving_monitors(),
+            default_rules=default_serving_rules,
+            log=getattr(config, "log", None))
+        self.health = self._live_plane.health
+        self.alerts = self._live_plane.alerts
+        self.metrics_server = self._live_plane.metrics
         self.cache = PredictionCache(config.SERVE_CACHE_SIZE)
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=config.SERVE_BATCH_MAX,
@@ -164,6 +183,7 @@ class PredictionServer:
                     compiled=self.model.predict_compile_count())
             self.batcher.start()
             self.watchdog.start()
+            self._live_plane.start()
             self._started = True
         return self
 
@@ -176,9 +196,11 @@ class PredictionServer:
                 self._extractor_kwargs = None
             self._started = False
         self.watchdog.stop()
-        # after teardown so a raise-mode sticky stall cannot leak the
-        # batcher/extractor threads by raising mid-close
+        self._live_plane.stop()
+        # after teardown so a raise-mode sticky stall/alert cannot
+        # leak the batcher/extractor threads by raising mid-close
         self.watchdog.poll()
+        self.alerts.poll()
 
     def extractor_pool(self, **extractor_kwargs) -> ExtractorPool:
         """The persistent extraction pool, built (and preflighted) once

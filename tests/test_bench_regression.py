@@ -1,0 +1,105 @@
+"""tools/bench_regression.py (ISSUE 7 satellite): the mechanical
+BENCH-trajectory gate, exercised on checked-in fixtures under
+tests/bench_fixtures/ (ok/ = latest inside the noise band, regress/ =
+latest 20% below the median) and on the repo's own real BENCH_r*.json
+trajectory."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.bench_regression import (check_metric, load_rounds, render,
+                                    run)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "bench_fixtures")
+
+
+def test_load_rounds_sorted_and_both_shapes():
+    rounds = load_rounds(os.path.join(FIXTURES, "ok"))
+    assert [r for r, _ in rounds] == [1, 2, 3, 4]
+    # r04 is bench.py's BARE result object (no "parsed" wrapper)
+    assert rounds[-1][1]["value"] == 96000.0
+
+
+def test_ok_trajectory_passes():
+    rc, rows = run(os.path.join(FIXTURES, "ok"),
+                   ["value", "transformer_pc_per_sec",
+                    "int8_pc_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False)
+    assert rc == 0
+    by = {r["metric"]: r for r in rows}
+    assert by["value"]["status"] == "ok"
+    # latest 96000 vs median(100000, 102000, 98000) = 100000
+    assert by["value"]["baseline"] == 100000.0
+    assert by["value"]["ratio"] == pytest.approx(0.96)
+    # int8 appears in only ONE prior round -> not gated, never a pass
+    # by omission that reads as a verdict
+    assert by["int8_pc_per_sec"]["status"] == "skip"
+
+
+def test_regression_fails_nonzero():
+    rc, rows = run(os.path.join(FIXTURES, "regress"),
+                   ["value", "transformer_pc_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False)
+    assert rc == 1
+    by = {r["metric"]: r for r in rows}
+    assert by["value"]["status"] == "REGRESSION"
+    assert by["transformer_pc_per_sec"]["status"] == "ok"
+    assert "REGRESSION" in render(rows)
+
+
+def test_band_floor_widens_with_noisy_history():
+    # history spread (MAD-based) wider than the flag floor must win:
+    # a historically jittery metric should not page on normal jitter
+    noisy = [(1, 100.0), (2, 140.0), (3, 60.0), (4, 95.0)]
+    row = check_metric("m", noisy, 5, 70.0, band_floor=0.05,
+                       min_history=2)
+    assert row["band"] > 0.05
+    assert row["status"] == "ok"  # inside the widened band
+    tight = [(1, 100.0), (2, 101.0), (3, 99.0)]
+    row = check_metric("m", tight, 4, 70.0, band_floor=0.05,
+                       min_history=2)
+    assert row["status"] == "REGRESSION"
+
+
+def test_insufficient_history_skips_then_strict_errors():
+    rc, rows = run(os.path.join(FIXTURES, "ok"), ["value"],
+                   band=0.05, window=5, min_history=10, strict=False)
+    assert rc == 0 and rows[0]["status"] == "skip"
+    rc, _rows = run(os.path.join(FIXTURES, "ok"), ["value"],
+                    band=0.05, window=5, min_history=10, strict=True)
+    assert rc == 2
+
+
+def test_empty_dir_is_usage_error(tmp_path):
+    rc, rows = run(str(tmp_path), ["value"], band=0.05, window=5,
+                   min_history=2, strict=False)
+    assert rc == 2 and rows == []
+
+
+def test_cli_exit_codes_and_json():
+    r = subprocess.run(
+        [sys.executable, "tools/bench_regression.py", "--dir",
+         os.path.join(FIXTURES, "regress"), "--metrics", "value",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout + r.stderr
+    rows = json.loads(r.stdout)
+    assert rows[0]["status"] == "REGRESSION"
+    r = subprocess.run(
+        [sys.executable, "tools/bench_regression.py", "--dir",
+         os.path.join(FIXTURES, "ok"), "--metrics", "value"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_repo_trajectory_is_loadable():
+    """The real BENCH_r*.json history stays parseable by the gate (the
+    driver runs it against exactly these files)."""
+    rounds = load_rounds(REPO)
+    assert len(rounds) >= 2
+    assert all("value" in res for _r, res in rounds)

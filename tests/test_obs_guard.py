@@ -18,11 +18,14 @@ import textwrap
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _tf_blocked_env(tmp_path):
+def _tf_blocked_env(tmp_path, block_jax=False):
     blocker = tmp_path / "tfblock"
-    blocker.mkdir()
+    blocker.mkdir(exist_ok=True)
     (blocker / "tensorflow.py").write_text(
         "raise ImportError('tensorflow blocked by test_obs_guard')\n")
+    if block_jax:
+        (blocker / "jax.py").write_text(
+            "raise ImportError('jax blocked by test_obs_guard')\n")
     env = dict(os.environ)
     parts = [str(blocker), REPO]
     if env.get("PYTHONPATH"):
@@ -97,6 +100,74 @@ def test_obs_imports_and_runs_without_tensorflow(tmp_path):
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "GUARD-OK" in r.stdout
+
+
+def test_live_plane_serves_and_evaluates_without_jax_or_tf(tmp_path):
+    """ISSUE 7 extension of the blocked-import pattern: the live
+    metrics plane — exposition server, health monitors, alert engine —
+    must import AND run (HTTP round-trips included) with BOTH jax and
+    tensorflow import-blocked. obs/ stays a pure-stdlib layer."""
+    code = textwrap.dedent("""
+        import json, sys, urllib.request
+        import code2vec_tpu.obs as obs
+        from code2vec_tpu.obs.alerts import AlertRule
+        from code2vec_tpu.obs.health import (NonFiniteGauges,
+                                             default_train_monitors)
+
+        # registry + live plane, fully in memory (no jax manifest)
+        t = obs.Telemetry.memory("guard").make_threadsafe()
+        t.count("train/steps", 3)
+        t.record_ms("train/step_ms", 5.0)
+        t.gauge("train/loss", float("nan"), emit=False)
+        clock = [0.0]
+        wd = obs.Watchdog(t, stall_s=5.0, clock=lambda: clock[0])
+        hb = wd.register("infeed_producer"); hb.beat()
+        health = obs.HealthEngine.create(t)
+        health.add(*default_train_monitors())
+        alerts = obs.AlertEngine.create(
+            t, mode="raise",
+            rules=[AlertRule("nan", metric="health/loss_nonfinite",
+                             op=">=", value=1.0)])
+        health.add_listener(alerts.evaluate)
+        wd.attach(health=health, alerts=alerts)
+        health.check_now()  # evaluates monitors, fires the rule
+        try:
+            alerts.poll()
+            raise SystemExit("sticky AlertError never surfaced")
+        except obs.AlertError:
+            pass
+
+        srv = obs.MetricsServer(t, port=0, watchdog=wd,
+                                health=health, alerts=alerts).start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=5).read().decode()
+        assert "train_steps 3" in text
+        assert 'alert_active{rule="nan"} 1' in text
+        assert 'health_status{monitor="loss_nonfinite"} 1' in text
+        assert "gauge_age_seconds" in text
+        v = json.load(urllib.request.urlopen(base + "/vars",
+                                             timeout=5))
+        assert v["counters"]["train/steps"] == 3
+        assert v["alerts"][0]["state"] == "firing"
+        # healthz: firing page-severity alert -> 503
+        import urllib.error
+        try:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+            raise SystemExit("healthz should be 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        srv.stop()
+        assert "jax" not in sys.modules
+        assert "tensorflow" not in sys.modules
+        print("LIVE-PLANE-OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code],
+                       env=_tf_blocked_env(tmp_path, block_jax=True),
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LIVE-PLANE-OK" in r.stdout
 
 
 def test_tier1_collection_is_tf_free(tmp_path):

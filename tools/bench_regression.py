@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Gate the latest BENCH round against the trajectory (ISSUE 7
+satellite).
+
+The driver captures one `BENCH_r<N>.json` per round; regressions so
+far have been caught by a human reading BASELINE.md. This tool makes
+the check mechanical:
+
+  python tools/bench_regression.py            # repo root, defaults
+  python tools/bench_regression.py --dir . --band 0.05
+
+For each gated metric (higher-is-better throughput figures), the
+LATEST round is compared against the MEDIAN of the previous
+`--window` rounds that report the metric. The tolerance band is the
+larger of `--band` (the noise floor — slope timing on the tunneled
+platform jitters a few percent run-to-run) and the observed relative
+spread of those prior rounds (median absolute deviation × 2 / median),
+so a historically noisy metric doesn't cry wolf and a historically
+flat one stays tight. Exit codes: 0 = no regression (or not enough
+history), 1 = regression, 2 = usage error. `--strict` makes
+insufficient history an error instead of a pass.
+
+Accepts both file shapes: the driver wrapper (`{"parsed": {...}}`)
+and bench.py's bare result object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# higher-is-better figures gated by default; ms_per_step & friends are
+# redundant inverses of these
+DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
+                   "fwd_bwd_floor_pc_per_sec")
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(dir_path: str, pattern: str = "BENCH_r*.json"
+                ) -> List[Tuple[int, Dict[str, Any]]]:
+    """[(round_n, result_dict)] sorted by round. Files that carry no
+    result (a failed round's wrapper) are skipped, not fatal."""
+    rounds = []
+    for path in glob.glob(os.path.join(dir_path, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        result = obj.get("parsed") if isinstance(obj, dict) else None
+        if result is None and isinstance(obj, dict) \
+                and "value" in obj:
+            result = obj  # bench.py's bare stdout object
+        if not isinstance(result, dict):
+            print(f"warning: {path} carries no parsed bench result; "
+                  "skipped", file=sys.stderr)
+            continue
+        rounds.append((int(m.group(1)), result))
+    rounds.sort()
+    return rounds
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def check_metric(metric: str, history: List[Tuple[int, float]],
+                 latest_round: int, latest: float,
+                 band_floor: float, min_history: int
+                 ) -> Dict[str, Any]:
+    """One metric's verdict row. `history` excludes the latest round."""
+    row: Dict[str, Any] = {"metric": metric, "round": latest_round,
+                           "latest": latest}
+    if len(history) < min_history:
+        row.update(status="skip",
+                   note=f"history {len(history)} < {min_history}")
+        return row
+    values = [v for _r, v in history]
+    baseline = _median(values)
+    if baseline <= 0:
+        row.update(status="skip", note="non-positive baseline")
+        return row
+    mad = _median([abs(v - baseline) for v in values])
+    band = max(band_floor, 2.0 * mad / baseline)
+    floor = baseline * (1.0 - band)
+    row.update(baseline=baseline, band=band, floor=floor,
+               ratio=latest / baseline,
+               status="REGRESSION" if latest < floor else "ok",
+               history_rounds=[r for r, _v in history])
+    return row
+
+
+def run(dir_path: str, metrics: List[str], band: float, window: int,
+        min_history: int, strict: bool) -> Tuple[int, List[Dict]]:
+    rounds = load_rounds(dir_path)
+    if not rounds:
+        print(f"error: no BENCH_r*.json with results under "
+              f"{dir_path}", file=sys.stderr)
+        return 2, []
+    latest_round, latest = rounds[-1]
+    prior = rounds[:-1]
+    rows = []
+    for metric in metrics:
+        if metric not in latest:
+            rows.append({"metric": metric, "round": latest_round,
+                         "status": "skip",
+                         "note": "absent from latest round"})
+            continue
+        history = [(r, float(res[metric])) for r, res in prior
+                   if metric in res][-window:]
+        rows.append(check_metric(metric, history, latest_round,
+                                 float(latest[metric]), band,
+                                 min_history))
+    regressed = [r for r in rows if r["status"] == "REGRESSION"]
+    skipped = [r for r in rows if r["status"] == "skip"]
+    if strict and len(skipped) == len(rows):
+        print("error: --strict and no metric had enough history",
+              file=sys.stderr)
+        return 2, rows
+    return (1 if regressed else 0), rows
+
+
+def render(rows: List[Dict[str, Any]]) -> str:
+    lines = ["| Metric | latest | baseline (median) | floor (band) "
+             "| ratio | verdict |",
+             "|---|---|---|---|---|---|"]
+
+    def f(v, nd=1):
+        return "—" if v is None else f"{v:,.{nd}f}"
+
+    for r in rows:
+        if r["status"] == "skip":
+            lines.append(f"| {r['metric']} | {f(r.get('latest'))} "
+                         f"| — | — | — | skip: {r['note']} |")
+            continue
+        lines.append(
+            f"| {r['metric']} | {f(r['latest'])} "
+            f"| {f(r['baseline'])} "
+            f"| {f(r['floor'])} ({r['band'] * 100:.1f}%) "
+            f"| {r['ratio']:.3f} | {r['status']} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare the latest BENCH_r*.json against the "
+                    "round trajectory; exit 1 on regression")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--metrics", nargs="+",
+                    default=list(DEFAULT_METRICS),
+                    help="result keys to gate (higher is better)")
+    ap.add_argument("--band", type=float, default=0.05,
+                    help="noise-band floor as a fraction (the "
+                         "tolerance is max of this and the history's "
+                         "observed spread)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="how many prior rounds form the baseline")
+    ap.add_argument("--min_history", type=int, default=2,
+                    help="prior rounds required before a metric is "
+                         "gated at all")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (exit 2) when NO metric has enough "
+                         "history, instead of passing quietly")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable row dump instead of the "
+                         "table")
+    args = ap.parse_args(argv)
+    rc, rows = run(args.dir, args.metrics, args.band, args.window,
+                   args.min_history, args.strict)
+    if rows:
+        print(json.dumps(rows, indent=1) if args.json
+              else render(rows))
+    if rc == 1:
+        print("REGRESSION: latest bench round fell below the "
+              "trajectory floor", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

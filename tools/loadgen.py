@@ -276,13 +276,26 @@ def main(argv=None) -> int:
                          "consumer (0 = off)")
     ap.add_argument("--watchdog_mode", default="warn",
                     choices=["warn", "raise"])
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="serve /metrics //healthz //vars from the "
+                         "PredictionServer while the load runs "
+                         "(0 = off)")
+    ap.add_argument("--alerts_mode", default="off",
+                    choices=["off", "warn", "raise"],
+                    help="serving health monitors (cache-hit "
+                         "collapse, shed burn-rate) + alert rules "
+                         "(defaults --telemetry_dir to a temp dir "
+                         "when unset — alert events need a run dir)")
+    ap.add_argument("--alerts_rules", default=None,
+                    help="JSON alert-rule file (see README)")
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args(argv)
     if args.load and args.synthetic:
         ap.error("--load and --synthetic are mutually exclusive")
-    if (args.trace or args.watchdog_stall_s > 0) \
-            and not args.telemetry_dir:
-        # spans and stall dumps live in the run dir — make one
+    if (args.trace or args.watchdog_stall_s > 0
+            or args.alerts_mode != "off") and not args.telemetry_dir:
+        # spans, stall dumps and alert events live in the run dir —
+        # make one
         args.telemetry_dir = tempfile.mkdtemp(prefix="loadgen_trace_")
 
     cfg, model = _build_model(args)
@@ -291,6 +304,9 @@ def main(argv=None) -> int:
     cfg.TRACE = bool(args.trace)
     cfg.WATCHDOG_STALL_S = args.watchdog_stall_s
     cfg.WATCHDOG_MODE = args.watchdog_mode
+    cfg.METRICS_PORT = args.metrics_port
+    cfg.ALERTS_MODE = args.alerts_mode
+    cfg.ALERTS_RULES = args.alerts_rules
 
     if args.corpus:
         with open(args.corpus, encoding="utf-8") as f:

@@ -1,0 +1,245 @@
+#!/usr/bin/env python3
+"""obs_top: live multi-host terminal view over N `/metrics` endpoints
+(ISSUE 7).
+
+The pull-based counterpart of `tools/telemetry_report.py --merge`:
+instead of aggregating per-process JSONL run dirs after the fact, poll
+each host's `--metrics_port` exposition endpoint on an interval and
+render ONE table — global throughput summed across hosts, per-host
+rows keeping the skew visible (a straggler host is a slow row, not a
+hidden average). MULTICHIP groundwork: a v4-32 pod run is 4 hosts ×
+one endpoint each.
+
+  python tools/obs_top.py host1:9100 host2:9100 [--interval 2]
+  python tools/obs_top.py localhost:9100 --once   # one sample, no TUI
+
+Rates (steps/s, examples/s, requests/s) are differenced between
+consecutive polls of each endpoint's cumulative counters;
+path-contexts/s = examples-rate × the `train_max_contexts` gauge the
+train loop publishes. Health verdicts, firing alerts, stalled
+components and stale gauges (age > --stale_s) come straight off the
+same scrape. Pure stdlib (urllib + re) — runs on a laptop against a
+pod with nothing installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict, float]]]:
+    """Text exposition format -> {metric: [(labels, value), ...]}."""
+    out: Dict[str, List[Tuple[Dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, raw = m.groups()
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        labels = (dict(_LABEL_RE.findall(labels_raw))
+                  if labels_raw else {})
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def scalar(metrics: Dict, name: str) -> Optional[float]:
+    """First unlabeled sample of a family (counters/gauges here carry
+    no labels)."""
+    for labels, value in metrics.get(name, ()):
+        if not labels:
+            return value
+    return None
+
+
+def labeled(metrics: Dict, name: str, **want) -> Optional[float]:
+    for labels, value in metrics.get(name, ()):
+        if all(labels.get(k) == v for k, v in want.items()):
+            return value
+    return None
+
+
+def scrape(endpoint: str, timeout_s: float = 3.0) -> Dict:
+    url = endpoint if "://" in endpoint else f"http://{endpoint}"
+    with urllib.request.urlopen(f"{url.rstrip('/')}/metrics",
+                                timeout=timeout_s) as resp:
+        return parse_prometheus(resp.read().decode("utf-8"))
+
+
+class EndpointState:
+    """One endpoint's scrape history: the previous counter sample, so
+    each poll yields rates."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self.last: Optional[Tuple[float, Dict]] = None  # (t, metrics)
+        self.error: Optional[str] = None
+
+    def poll(self, stale_s: float) -> Optional[Dict[str, Any]]:
+        """Scrape once; returns a row dict (None until two samples
+        exist for the rate fields — other fields fill in on the first
+        poll)."""
+        t = time.monotonic()
+        try:
+            metrics = scrape(self.endpoint)
+            self.error = None
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self.error = str(getattr(e, "reason", e))
+            return {"endpoint": self.endpoint, "error": self.error}
+        prev, self.last = self.last, (t, metrics)
+
+        def rate(counter: str) -> Optional[float]:
+            cur = scalar(metrics, counter)
+            if prev is None or cur is None:
+                return None
+            old = scalar(prev[1], counter)
+            dt = t - prev[0]
+            if old is None or dt <= 0:
+                return None
+            return max(0.0, cur - old) / dt
+
+        ex_rate = rate("train_examples")
+        max_ctx = scalar(metrics, "train_max_contexts")
+        stalled = [labels.get("component", "?")
+                   for labels, v in metrics.get("component_stalled", ())
+                   if v]
+        firing = [labels.get("rule", "?")
+                  for labels, v in metrics.get("alert_active", ())
+                  if v]
+        unhealthy = [labels.get("monitor", "?")
+                     for labels, v in metrics.get("health_status", ())
+                     if v]
+        stale = [labels.get("gauge", "?")
+                 for labels, v in metrics.get("gauge_age_seconds", ())
+                 if v > stale_s]
+        return {
+            "endpoint": self.endpoint,
+            "steps": scalar(metrics, "train_steps"),
+            "steps_s": rate("train_steps"),
+            "ex_s": ex_rate,
+            "pc_s": (ex_rate * max_ctx
+                     if ex_rate is not None and max_ctx else None),
+            "step_p50": labeled(metrics, "train_step_ms",
+                                quantile="0.5"),
+            "infeed_p95": labeled(metrics, "train_infeed_wait_ms",
+                                  quantile="0.95"),
+            "req_s": rate("serve_requests"),
+            "queue_depth": scalar(metrics, "serve_queue_depth"),
+            "loss": scalar(metrics, "train_loss"),
+            "stalled": stalled,
+            "alerts": firing,
+            "unhealthy": unhealthy,
+            "stale_gauges": stale,
+        }
+
+
+def _f(v, nd: int = 1) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float) and v != v:
+        return "NaN"
+    return f"{v:,.{nd}f}"
+
+
+def render(rows: List[Dict[str, Any]]) -> str:
+    """One frame: the summed headline + per-host skew rows (the
+    telemetry_report --merge table shape, live)."""
+    lines: List[str] = []
+    ok_rows = [r for r in rows if "error" not in r]
+    total_pc = sum(r["pc_s"] for r in ok_rows
+                   if r.get("pc_s") is not None) or None
+    total_req = sum(r["req_s"] for r in ok_rows
+                    if r.get("req_s") is not None) or None
+    n_bad = sum(bool(r.get("stalled") or r.get("alerts"))
+                for r in ok_rows)
+    lines.append(
+        f"obs_top — {len(ok_rows)}/{len(rows)} hosts up | "
+        f"pc/s (sum) {_f(total_pc)} | req/s (sum) {_f(total_req)} | "
+        f"{n_bad} host(s) unhealthy | "
+        f"{time.strftime('%H:%M:%S')}")
+    lines.append(
+        "| Host | steps | ex/s | pc/s | step p50 ms | infeed p95 ms "
+        "| req/s | q | loss | status |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        if "error" in r:
+            lines.append(f"| {r['endpoint']} | DOWN: {r['error']} "
+                         "| | | | | | | | |")
+            continue
+        bits = []
+        if r["stalled"]:
+            bits.append("STALLED:" + ",".join(r["stalled"]))
+        if r["alerts"]:
+            bits.append("ALERT:" + ",".join(r["alerts"]))
+        if r["unhealthy"]:
+            bits.append("bad:" + ",".join(r["unhealthy"]))
+        if r["stale_gauges"]:
+            bits.append(f"{len(r['stale_gauges'])} stale gauge(s)")
+        lines.append(
+            f"| {r['endpoint']} | {_f(r['steps'], 0)} "
+            f"| {_f(r['ex_s'])} | {_f(r['pc_s'])} "
+            f"| {_f(r['step_p50'], 2)} | {_f(r['infeed_p95'], 2)} "
+            f"| {_f(r['req_s'])} | {_f(r['queue_depth'], 0)} "
+            f"| {_f(r['loss'], 4)} "
+            f"| {' '.join(bits) if bits else 'ok'} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live multi-host view over /metrics endpoints")
+    ap.add_argument("endpoints", nargs="+",
+                    help="host:port (or full URL) of each "
+                         "--metrics_port exposition server")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="two quick polls (rates need a delta), one "
+                         "printed frame, exit — the scripting mode")
+    ap.add_argument("--count", type=int, default=0,
+                    help="stop after N frames (0 = run until ^C)")
+    ap.add_argument("--stale_s", type=float, default=60.0,
+                    help="mark gauges older than this as stale")
+    args = ap.parse_args(argv)
+    states = [EndpointState(e) for e in args.endpoints]
+
+    def frame() -> List[Dict[str, Any]]:
+        return [s.poll(args.stale_s) for s in states]
+
+    if args.once:
+        frame()  # prime the counter baselines
+        time.sleep(max(args.interval, 0.05))
+        print(render(frame()))
+        return 0
+    n = 0
+    try:
+        while True:
+            rows = frame()
+            if n:  # first frame has no rates yet; start painting at 2
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(render(rows))
+            n += 1
+            if args.count and n > args.count:
+                return 0
+            time.sleep(max(args.interval, 0.05))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

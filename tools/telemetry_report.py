@@ -279,6 +279,26 @@ def render(run_dirs: List[str]) -> str:
                     f"| {_fmt(r['total_ms'])} "
                     f"| {_fmt(r['eval_ms'])} "
                     f"| {_fmt(r['overlap'], 3)} |")
+        # ---- alerts (obs/alerts.py): one row per edge-triggered
+        # transition — the run's incident log in table form ----
+        alert_events = [e for e in events if e.get("kind") == "alert"]
+        if alert_events:
+            t0 = manifest.get("created_unix")
+            lines.append("")
+            lines.append("| Alert | transition | rule kind | metric "
+                         "| observed | threshold | severity | t+ s |")
+            lines.append("|---|---|---|---|---|---|---|---|")
+            for e in alert_events:
+                offs = (_fmt(float(e["ts"]) - float(t0), 1)
+                        if t0 is not None and "ts" in e else "—")
+                lines.append(
+                    f"| {e.get('rule', '?')} "
+                    f"| {e.get('transition', '?')} "
+                    f"| {e.get('rule_kind', '?')} "
+                    f"| {e.get('metric', '?')} {e.get('op', '')} "
+                    f"| {_fmt(e.get('value'), 4)} "
+                    f"| {_fmt(e.get('threshold'), 4)} "
+                    f"| {e.get('severity', '?')} | {offs} |")
         bench_events = [e for e in events if e.get("kind") == "bench"]
         for b in bench_events:
             lines.append("")
