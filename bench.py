@@ -21,6 +21,14 @@ Extra keys:
     gains need less *traffic*, not better overlap.
   - transformer_*: the same measurement for --encoder transformer
     (xf_layers=2), the BASELINE.json configs[4] stretch encoder.
+  - sparse_*: the carrier-free sparse-update config (ROADMAP item 1:
+    --sparse_embeddings, gathered-row diff + dedup/segment-sum +
+    live-row row-Adam) with the update phase attributed every round:
+    sparse_update_ms (the apply alone, fused Pallas live-row kernel on
+    TPU), sparse_update_bytes ([U, E]-aware analytic bytes),
+    sparse_update_unique_rows, and sparse_step_floor_pc_per_sec — the
+    corrected analytic floor counting [U, E] traffic instead of the
+    dense [V, E] carrier.
   - int8_*: the sub-bf16 memory-lever config (ops/quant.py), with the
     requantize phase attributed every round: int8_requant_ms (the
     apply alone, fused Pallas row-pass on TPU), int8_requant_bytes
@@ -199,6 +207,130 @@ def _measure_fwd_bwd_floor():
     return BATCH * MAX_CONTEXTS / dt
 
 
+def _measure_sparse_update_phase():
+    """Slope-time the sparse table-update apply ALONE (dedup +
+    segment-sum + live-row row-Adam over the three tables — the
+    training/sparse_update facade exactly as the sparse train step runs
+    it: fused Pallas live-row kernel on TPU, XLA reference elsewhere)
+    plus the analytic [U, E] bytes one apply must move, so the phase is
+    attributed against the streaming ceiling every round. Returns
+    (ms, bytes, unique_rows, fused?)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from code2vec_tpu.models.encoder import init_params
+    from code2vec_tpu.training.sparse_adam import init_row_adam
+    from code2vec_tpu.training.sparse_update import \
+        sparse_update_traffic_bytes
+
+    dims = _java_large_dims()
+    params = init_params(jax.random.PRNGKey(0), dims)
+    batch = _device_batches(1)[0]
+    labels, src, pth, dst, _mask, _w = batch
+    fused = jax.default_backend() == "tpu"
+
+    # the exact id/cotangent layout the sparse step feeds the facade
+    # (target rows are code-vector-wide, not E-wide)
+    r = np.random.default_rng(5)
+    sampled = jnp.asarray(
+        r.integers(0, TARGET_VOCAB, NUM_SAMPLED), jnp.int32)
+    table_ids = {
+        "token_emb": jnp.concatenate([src.reshape(-1),
+                                      dst.reshape(-1)]),
+        "path_emb": pth.reshape(-1),
+        "target_emb": jnp.concatenate([labels, sampled]),
+    }
+    grads = {k: jnp.asarray(
+        r.normal(size=(int(v.size), params[k].shape[-1])) * 1e-3,
+        jnp.bfloat16)
+        for k, v in table_ids.items()}
+    tables = {k: params[k] for k in table_ids}
+    states = {k: init_row_adam(params[k]) for k in table_ids}
+
+    unique_rows = {k: int(np.unique(np.asarray(v)).size)
+                   for k, v in table_ids.items()}
+    nbytes = sum(
+        sparse_update_traffic_bytes(tables[k], int(v.size),
+                                    unique_rows[k], grad_itemsize=2)
+        for k, v in table_ids.items())
+
+    from code2vec_tpu.training.sparse_update import sparse_row_adam
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def apply(tables, states, count):
+        new_t, new_s = {}, {}
+        for k in sorted(tables):
+            new_t[k], new_s[k] = sparse_row_adam(
+                tables[k], states[k], table_ids[k], grads[k],
+                count=count, lr=1e-3, fused=fused)
+        return new_t, new_s, count + 1
+
+    def chain(n, state):
+        tables, states, count = state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tables, states, count = apply(tables, states, count)
+        # hard sync via a scalar host transfer (slope-timing contract)
+        float(tables["path_emb"].ravel()[0])
+        return time.perf_counter() - t0, (tables, states, count)
+
+    dt = max(_slope_time(chain, (tables, states,
+                                 jnp.asarray(1, jnp.int32))), 1e-9)
+    return dt * 1e3, nbytes, sum(unique_rows.values()), fused
+
+
+def _measure_sparse_step():
+    """The full sparse-update train step (make_train_step's sparse
+    dispatch — gathered-row diff + dedup/segment-sum/live-row apply,
+    bf16 tables, row-Adam): the config ROADMAP item 1 aims at the old
+    8.48M fwd/bwd floor with. Returns (pc/s, ms, hbm_gbps,
+    floor_bytes) — hbm_gbps uses the [U, E]-aware analytic traffic
+    model (sparse_update.sparse_step_floor_bytes), NOT the dense
+    _step_hbm_bytes, whose [V, E] carrier + full-table walk this step
+    does not perform; the caller derives the corrected floor from
+    floor_bytes over the measured ceiling."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from code2vec_tpu.models.encoder import init_params
+    from code2vec_tpu.training.sparse_steps import init_sparse_opt_state
+    from code2vec_tpu.training.sparse_update import \
+        sparse_step_floor_bytes
+    from code2vec_tpu.training.steps import make_train_step
+
+    dims = _java_large_dims()
+    params = init_params(jax.random.PRNGKey(0), dims)
+    dense_opt = optax.adam(1e-3)
+    opt_state = init_sparse_opt_state(params, dense_opt, True)
+    step = make_train_step(dims, dense_opt, use_sampled_softmax=True,
+                           num_sampled=NUM_SAMPLED,
+                           compute_dtype=jnp.bfloat16,
+                           use_pallas=jax.default_backend() == "tpu",
+                           sparse_updates=True, learning_rate=1e-3)
+    floor_bytes = sparse_step_floor_bytes(params, BATCH, MAX_CONTEXTS,
+                                          num_sampled=NUM_SAMPLED)
+    batches = _device_batches()
+
+    def chain(n, state):
+        params, opt_state, rng = state
+        rng, sub = jax.random.split(rng)
+        keys = list(jax.random.split(sub, max(n, 1)))
+        t0 = time.perf_counter()
+        for i in range(n):
+            params, opt_state, loss = step(params, opt_state,
+                                           batches[i % len(batches)],
+                                           keys[i])
+        float(loss)
+        return time.perf_counter() - t0, (params, opt_state, rng)
+
+    dt = _slope_time(chain, (params, opt_state, jax.random.PRNGKey(2)))
+    return (BATCH * MAX_CONTEXTS / dt, dt * 1e3,
+            floor_bytes / dt / 1e9, floor_bytes)
+
+
 def _measure_requant_phase():
     """Slope-time the int8 requantize apply ALONE over the two
     quantized tables (the fused Pallas row-pass on TPU, the XLA
@@ -345,9 +477,16 @@ def main(argv=None) -> None:
     rq_ms, rq_bytes, rq_fused = _measure_requant_phase()
     rq_gbps = rq_bytes / (rq_ms / 1e3) / 1e9
     _live(int8_requant_ms=rq_ms, phases_done=5)
+    sp_value, sp_ms, sp_hbm, sp_floor_bytes = _measure_sparse_step()
+    sp_floor = BATCH * MAX_CONTEXTS / (sp_floor_bytes / ceiling)
+    _live(sparse_pc_per_sec=sp_value, sparse_ms_per_step=sp_ms,
+          phases_done=6)
+    su_ms, su_bytes, su_rows, su_fused = _measure_sparse_update_phase()
+    su_gbps = su_bytes / (su_ms / 1e3) / 1e9
+    _live(sparse_update_ms=su_ms, phases_done=7)
     xf_value, xf_ms, xf_hbm = _measure_encoder("transformer")
     _live(transformer_pc_per_sec=xf_value,
-          transformer_ms_per_step=xf_ms, phases_done=6)
+          transformer_ms_per_step=xf_ms, phases_done=8)
     result = {
         "metric": "path-contexts/sec/chip",
         "value": round(value, 1),
@@ -391,6 +530,30 @@ def main(argv=None) -> None:
         "int8_requant_floor_ms": round(rq_bytes / ceiling * 1e3, 3),
         "int8_requant_vs_ceiling": round(rq_gbps / (ceiling / 1e9), 3),
         "int8_requant_fused": rq_fused,
+        # sparse table-update lever (ROADMAP item 1, round 13): the
+        # carrier-free step (--sparse_embeddings, bf16 tables,
+        # row-Adam) + the dedup/segment-sum/live-row phase attributed
+        # alone. sparse_step_floor_pc_per_sec is the CORRECTED analytic
+        # floor counting [U, E] traffic (sparse_update.
+        # sparse_step_floor_bytes) instead of the dense [V, E] carrier
+        # + full-table walk; the acceptance story is sparse_pc_per_sec
+        # punching through the old measured fwd_bwd floor above while
+        # sparse_optimizer_efficiency (vs that OLD floor) exceeds 0.9.
+        "sparse_pc_per_sec": round(sp_value, 1),
+        "sparse_ms_per_step": round(sp_ms, 2),
+        "sparse_hbm_gbps": round(sp_hbm, 1),
+        "sparse_vs_baseline": round(
+            sp_value / V100_BASELINE_PATH_CONTEXTS_PER_SEC, 3),
+        "sparse_step_floor_pc_per_sec": round(sp_floor, 1),
+        "sparse_optimizer_efficiency": round(sp_value / floor, 3),
+        "sparse_update_ms": round(su_ms, 3),
+        "sparse_update_bytes": int(su_bytes),
+        "sparse_update_gbps": round(su_gbps, 1),
+        "sparse_update_floor_ms": round(su_bytes / ceiling * 1e3, 3),
+        "sparse_update_vs_ceiling": round(
+            su_gbps / (ceiling / 1e9), 3),
+        "sparse_update_unique_rows": int(su_rows),
+        "sparse_update_fused": su_fused,
         "transformer_pc_per_sec": round(xf_value, 1),
         "transformer_ms_per_step": round(xf_ms, 2),
         "transformer_hbm_gbps": round(xf_hbm, 1),

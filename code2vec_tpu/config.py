@@ -41,6 +41,10 @@ CONFIG_CONSTANTS = frozenset({
     "HEALTH_EVERY_S",            # monitor cadence; tests inject tiny
     #                              values directly, production default
     #                              is deliberately not a tuning knob
+    "HBM_CEILING_GBPS",          # measured streaming ceiling (bench.py
+    #                              re-measures every round; this is the
+    #                              denominator for the LIVE analytic
+    #                              floor gauges only, not a tuning knob
 })
 
 
@@ -102,12 +106,17 @@ class Config:
     MESH_DCN_AXIS: int = 1    # multi-slice data axis (batch shards over
     #                           dcn x data; cross-slice psum rides DCN)
     USE_BF16: bool = True     # compute in bfloat16 on the MXU, params f32
-    # Touched-rows-only (lazy) Adam for the vocab tables. Measured on one
-    # v5e chip at java-large scale: row-granular scatter/gather runs at
-    # ~13 GB/s effective there, so dense Adam (45 ms/step) beats the
-    # sparse step (85 ms/step) despite 9 GB of moment traffic — default
-    # off; flip on for configs where the tables dwarf HBM or scatters
-    # are fast.
+    # Touched-rows-only (lazy) Adam for the vocab tables
+    # (training/sparse_steps.py + the round-13 sparse_update facade:
+    # gathered-row differentiation, dedup + segment-sum into a compact
+    # [U, E] gradient, live-rows-only apply — no dense [V, E] carrier).
+    # BENCH_r05 pins the dense path at optimizer efficiency 0.786
+    # against its 8.48M pc/s fwd/bwd floor; this is the lever that
+    # closes the gap (SPARSE_UPDATE_PALLAS selects the fused kernel).
+    # Default off until a TPU driver round lands the measured win:
+    # flags-off numerics are the shipped trajectory. Supports
+    # float32/bfloat16/int8 tables, adam embedding optimizer,
+    # constant LR, bag encoder (verify() gates the rest).
     SPARSE_EMBEDDING_UPDATES: bool = False
     # Storage dtype for the three vocab tables. bf16 halves the
     # gather/scatter/optimizer HBM traffic dominating java-large steps
@@ -140,6 +149,22 @@ class Config:
     # the CPU test path); "reference" forces the multi-pass form
     # (the round-5 baseline, kept for A/B attribution).
     REQUANT_PALLAS: str = "auto"  # "auto" | "fused" | "reference"
+    # Sparse table-update implementation (only meaningful with
+    # --sparse_embeddings, single-device runs): "auto" = the fused
+    # Pallas live-row kernel (ops/pallas_sparse_update.py) on a
+    # single-device TPU backend, the XLA segment-sum reference on CPU;
+    # "fused" forces the kernel (interpret mode off-TPU — the CPU test
+    # path); "reference" forces the XLA form (the A/B numerics
+    # baseline). Under a MESH this flag is not consulted: the sparse
+    # step keeps the pre-round-13 dense-carrier apply (f32 tables
+    # only — sparse_steps.py documents the GSPMD gate).
+    SPARSE_UPDATE_PALLAS: str = "auto"  # "auto" | "fused" | "reference"
+    # Measured single-chip HBM streaming ceiling (GB/s) — bench.py
+    # re-measures the real value every round; this constant only feeds
+    # the LIVE analytic-floor gauges (train/step_floor_ms and the
+    # health opt_efficiency monitor) where running the 1-GiB membench
+    # mid-train would perturb the run being observed.
+    HBM_CEILING_GBPS: float = 637.0
     # Double-buffered device infeed (data/prefetch.py; SURVEY.md §3.3
     # infeed row): how many batches ahead a daemon thread runs the host
     # parse + host->device transfer. 2 = classic double buffering
@@ -490,10 +515,12 @@ class Config:
         p.add_argument("--sparse_embeddings", dest="sparse_embeddings",
                        action="store_true",
                        help="touched-rows-only (lazy) Adam for the "
-                            "vocab tables (requires --tables_dtype "
-                            "float32 --embedding_optimizer adam "
-                            "--lr_schedule constant; measured slower "
-                            "than dense on v5e — see ARCHITECTURE.md)")
+                            "vocab tables via the dedup + segment-sum "
+                            "+ live-row sparse-update path — no dense "
+                            "[V, E] gradient carrier (requires "
+                            "--embedding_optimizer adam "
+                            "--lr_schedule constant; float32/bfloat16/"
+                            "int8 tables; see --sparse_update_pallas)")
         p.add_argument("--embedding_optimizer", dest="embedding_optimizer",
                        default=None, choices=["adam", "adafactor"])
         p.add_argument("--requant_pallas", dest="requant_pallas",
@@ -502,6 +529,15 @@ class Config:
                        help="int8 requantize implementation: fused "
                             "Pallas row-pass (auto on TPU) or the "
                             "multi-pass XLA reference")
+        p.add_argument("--sparse_update_pallas",
+                       dest="sparse_update_pallas", default=None,
+                       choices=["auto", "fused", "reference"],
+                       help="sparse table-update implementation under "
+                            "--sparse_embeddings: fused Pallas "
+                            "live-row kernel (auto on single-device "
+                            "TPU) or the XLA segment-sum reference; "
+                            "not consulted under a mesh (dense-"
+                            "carrier apply, f32 tables only)")
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--mesh_context", dest="mesh_context", type=int,
@@ -705,6 +741,8 @@ class Config:
             cfg.EMBEDDING_OPTIMIZER = ns.embedding_optimizer
         if ns.requant_pallas is not None:
             cfg.REQUANT_PALLAS = ns.requant_pallas
+        if ns.sparse_update_pallas is not None:
+            cfg.SPARSE_UPDATE_PALLAS = ns.sparse_update_pallas
         if ns.mesh_data is not None:
             cfg.MESH_DATA_AXIS = ns.mesh_data
         if ns.mesh_model is not None:
@@ -808,16 +846,24 @@ class Config:
             raise ValueError(
                 "--predict/--release/--save_w2v/--save_t2v/"
                 "--export_code_vectors apply to the code2vec head only.")
-        if self.SPARSE_EMBEDDING_UPDATES and (
-                self.TABLES_DTYPE != "float32"
-                or self.EMBEDDING_OPTIMIZER != "adam"):
+        if self.SPARSE_EMBEDDING_UPDATES and \
+                self.EMBEDDING_OPTIMIZER != "adam":
+            # the live-row update IS row-Adam; adafactor's factored
+            # column stats are global over V and cannot be updated at
+            # row granularity without a full-table walk
             raise ValueError(
-                "SPARSE_EMBEDDING_UPDATES requires float32 tables and "
-                "the adam embedding optimizer.")
+                "SPARSE_EMBEDDING_UPDATES requires the adam embedding "
+                "optimizer (the live-row kernel applies row-Adam; "
+                "float32/bfloat16/int8 tables are all supported).")
         if self.REQUANT_PALLAS not in ("auto", "fused", "reference"):
             raise ValueError(
                 "--requant_pallas must be auto, fused or reference "
                 f"(got {self.REQUANT_PALLAS!r}).")
+        if self.SPARSE_UPDATE_PALLAS not in ("auto", "fused",
+                                             "reference"):
+            raise ValueError(
+                "--sparse_update_pallas must be auto, fused or "
+                f"reference (got {self.SPARSE_UPDATE_PALLAS!r}).")
         if self.TABLES_DTYPE == "int8":
             # the int8 path covers the shipped per-chip training config
             # (bag encoder, single device); the gated combinations read

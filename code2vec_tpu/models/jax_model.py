@@ -163,6 +163,20 @@ class Code2VecModel(Code2VecModelBase):
                 raise ValueError(
                     "--tables_dtype int8 supports data-parallel meshes "
                     f"only; got mesh {shape}")
+        if cfg.SPARSE_EMBEDDING_UPDATES and self.mesh is not None \
+                and self.dims.tables_dtype != "float32":
+            # the mesh sparse step keeps the SPMD-proven dense-carrier
+            # apply, which is f32-only (bf16 would accumulate
+            # duplicate-row cotangents in bf16 and scatter f32 rows
+            # into a bf16 table; int8 has no carrier form). Same
+            # after-the-manifest-override placement as the int8 guard
+            # above; sparse_steps raises too — this is the model-level
+            # error with the flag names.
+            raise ValueError(
+                "--sparse_embeddings under a mesh requires "
+                "--tables_dtype float32 (the mesh path's dense-carrier "
+                f"apply is f32-only; got {self.dims.tables_dtype}); "
+                "bf16/int8 sparse tables are single-device")
 
         def n_train_examples() -> int:
             # dict pickle already carries the count; rescan the file
@@ -222,34 +236,31 @@ class Code2VecModel(Code2VecModelBase):
             opt_state = shard_opt_state(self.mesh, opt_state, params)
         self.params, self.opt_state = params, opt_state
 
-        # ---- jitted steps ----
-        if cfg.SPARSE_EMBEDDING_UPDATES:
-            from code2vec_tpu.training.sparse_steps import (
-                make_sparse_train_step)
-            self._train_step = make_sparse_train_step(
-                self.dims, learning_rate=cfg.LEARNING_RATE,
-                dense_optimizer=self.optimizer,
-                use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
-                num_sampled=cfg.NUM_SAMPLED_CLASSES,
-                compute_dtype=self.compute_dtype)
-        else:
-            augment_fn = None
-            if cfg.ADV_RENAME_PROB > 0:
-                # adversarial-training defense (attacks/defense.py)
-                from code2vec_tpu.attacks.defense import (
-                    legal_token_mask, make_rename_augment)
-                augment_fn = make_rename_augment(
-                    legal_token_mask(self.vocabs.token_vocab, self.dims),
-                    cfg.ADV_RENAME_PROB, mode=cfg.ADV_RENAME_MODE)
-            from code2vec_tpu.ops.quant import resolve_requant_mode
-            self._train_step = make_train_step(
-                self.dims, self.optimizer,
-                use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
-                num_sampled=cfg.NUM_SAMPLED_CLASSES,
-                compute_dtype=self.compute_dtype,
-                use_pallas=self.use_pallas, mesh=self.mesh,
-                augment_fn=augment_fn,
-                requant_fused=resolve_requant_mode(cfg.REQUANT_PALLAS))
+        # ---- jitted steps (make_train_step owns the sparse-vs-dense
+        # dispatch; Config.verify gates the combinations) ----
+        augment_fn = None
+        if cfg.ADV_RENAME_PROB > 0:
+            # adversarial-training defense (attacks/defense.py)
+            from code2vec_tpu.attacks.defense import (
+                legal_token_mask, make_rename_augment)
+            augment_fn = make_rename_augment(
+                legal_token_mask(self.vocabs.token_vocab, self.dims),
+                cfg.ADV_RENAME_PROB, mode=cfg.ADV_RENAME_MODE)
+        from code2vec_tpu.ops.quant import resolve_requant_mode
+        from code2vec_tpu.training.sparse_update import \
+            resolve_sparse_update_mode
+        self._train_step = make_train_step(
+            self.dims, self.optimizer,
+            use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
+            num_sampled=cfg.NUM_SAMPLED_CLASSES,
+            compute_dtype=self.compute_dtype,
+            use_pallas=self.use_pallas, mesh=self.mesh,
+            augment_fn=augment_fn,
+            requant_fused=resolve_requant_mode(cfg.REQUANT_PALLAS),
+            sparse_updates=cfg.SPARSE_EMBEDDING_UPDATES,
+            learning_rate=cfg.LEARNING_RATE,
+            sparse_update_fused=resolve_sparse_update_mode(
+                cfg.SPARSE_UPDATE_PALLAS))
         # background checkpoint writer (--async_checkpoint, default on):
         # created lazily at the first save so load/predict-only model
         # instances never start the thread
@@ -398,6 +409,37 @@ class Code2VecModel(Code2VecModelBase):
         # (static: a set-once config echo must not read as stale)
         telemetry.gauge("train/max_contexts", cfg.MAX_CONTEXTS,
                         emit=False, static=True)
+        if cfg.SPARSE_EMBEDDING_UPDATES and self.mesh is None:
+            # live optimizer-efficiency plane (round 13): publish the
+            # [U, E]-aware analytic step floor once; the health
+            # engine's opt_efficiency monitor divides it by the
+            # observed p50 step time every sweep, so a step-time
+            # regression is visible on /metrics and tools/obs_top.py
+            # mid-run, not just at bench time. (Static: analytic
+            # facts, not heartbeats. Single-device only: mesh sparse
+            # runs execute the dense-carrier apply, which this [U, E]
+            # model does not describe — publishing it there would
+            # read as a false 'bad' opt_efficiency; without the gauge
+            # the monitor correctly stays 'unknown'.)
+            from code2vec_tpu.training.sparse_update import (
+                sparse_step_floor_bytes, sparse_update_phase_bytes)
+            ns = cfg.NUM_SAMPLED_CLASSES if cfg.USE_SAMPLED_SOFTMAX \
+                else 0
+            step_bytes = sparse_step_floor_bytes(
+                self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
+                num_sampled=ns)
+            upd_bytes = sparse_update_phase_bytes(
+                self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
+                num_sampled=ns)
+            ceiling = cfg.HBM_CEILING_GBPS * 1e9
+            telemetry.gauge("train/step_floor_ms",
+                            step_bytes / ceiling * 1e3, emit=False,
+                            static=True)
+            telemetry.gauge("train/sparse_update_bytes", upd_bytes,
+                            emit=False, static=True)
+            telemetry.gauge("train/sparse_update_floor_ms",
+                            upd_bytes / ceiling * 1e3, emit=False,
+                            static=True)
         loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +

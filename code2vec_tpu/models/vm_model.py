@@ -67,6 +67,10 @@ class VarMisuseModel:
             # current adafactor default — see jax_model.py
             cfg.EMBEDDING_OPTIMIZER = manifest.get(
                 "embedding_optimizer", "adam")
+            # opt_state structure follows this exactly like the
+            # optimizer choice does (sparse dict vs optax chain)
+            cfg.SPARSE_EMBEDDING_UPDATES = manifest.get(
+                "sparse_embedding_updates", cfg.SPARSE_EMBEDDING_UPDATES)
             cfg.TRUST_RATIO = manifest.get("trust_ratio", False)
             from code2vec_tpu.training.optimizers import (
                 resolve_checkpoint_schedule, resolve_checkpoint_warmup)
@@ -100,7 +104,20 @@ class VarMisuseModel:
         self.rng = jax.random.PRNGKey(cfg.SEED)
         self.rng, init_rng = jax.random.split(self.rng)
         params = init_vm_params(init_rng, self.dims)
-        opt_state = self.optimizer.init(params)
+        if cfg.SPARSE_EMBEDDING_UPDATES:
+            # verify() enforces these for CLI runs; assert for
+            # programmatic Config users (same contract as jax_model)
+            assert cfg.EMBEDDING_OPTIMIZER == "adam", (
+                "SPARSE_EMBEDDING_UPDATES requires "
+                "EMBEDDING_OPTIMIZER='adam'")
+            assert cfg.LR_SCHEDULE == "constant", (
+                "SPARSE_EMBEDDING_UPDATES requires "
+                "LR_SCHEDULE='constant'")
+            from code2vec_tpu.training.vm_steps import \
+                init_vm_sparse_opt_state
+            opt_state = init_vm_sparse_opt_state(params, self.optimizer)
+        else:
+            opt_state = self.optimizer.init(params)
         self.step_num = 0
         if cfg.is_loading:
             full = ckpt.load_checkpoint(
@@ -116,9 +133,16 @@ class VarMisuseModel:
         # background checkpoint writer (--async_checkpoint, default on);
         # lazy so load/eval-only instances never start the thread
         self._ckpt_writer = None
+        from code2vec_tpu.training.sparse_update import \
+            resolve_sparse_update_mode
         self._train_step = make_vm_train_step(
             self.dims, self.optimizer, compute_dtype=self.compute_dtype,
-            use_pallas=self.use_pallas)
+            use_pallas=self.use_pallas,
+            sparse_updates=cfg.SPARSE_EMBEDDING_UPDATES,
+            learning_rate=cfg.LEARNING_RATE,
+            sparse_update_fused=resolve_sparse_update_mode(
+                cfg.SPARSE_UPDATE_PALLAS),
+            mesh=self.mesh)
         self._eval_step = make_vm_eval_step(
             self.dims, compute_dtype=self.compute_dtype,
             use_pallas=self.use_pallas)
@@ -358,6 +382,8 @@ class VarMisuseModel:
         extra = {"head": "varmisuse",
                  "max_candidates": self.config.MAX_CANDIDATES,
                  "embedding_optimizer": self.config.EMBEDDING_OPTIMIZER,
+                 "sparse_embedding_updates":
+                     self.config.SPARSE_EMBEDDING_UPDATES,
                  "trust_ratio": self.config.TRUST_RATIO,
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS}

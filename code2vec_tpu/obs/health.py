@@ -23,6 +23,10 @@ for the alert engine (obs/alerts.py) to threshold on:
     against a group (infeed starvation: wait / (wait + step)).
   - `CounterRatio` — windowed numerator/denominator counter deltas
     (serving cache-hit rate, shed rate).
+  - `OptEfficiency` — analytic-floor attainment of the train step:
+    the sparse path's static `train/step_floor_ms` gauge (the
+    [U, E]-aware traffic model, round 13) over observed p50 step
+    time — bench.py's optimizer-efficiency story, live.
 
 Monitors only READ the registry (snapshot-don't-lock: dict reads of
 float values are atomic under the GIL; a torn multi-metric view skews
@@ -50,7 +54,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["HealthEngine", "Monitor", "NonFiniteGauges", "EwmaZScore",
-           "CounterRate", "TimerShare", "CounterRatio",
+           "CounterRate", "TimerShare", "CounterRatio", "OptEfficiency",
            "default_train_monitors", "default_serving_monitors"]
 
 
@@ -305,15 +309,61 @@ class CounterRatio(Monitor):
         self._publish(telemetry, ratio, status)
 
 
+class OptEfficiency(Monitor):
+    """Analytic-floor attainment of the train step: a STATIC floor
+    gauge (`train/step_floor_ms` — published once by the sparse-update
+    train loop from the [U, E]-aware traffic model in
+    training/sparse_update.py, over the HBM_CEILING_GBPS constant)
+    divided by the observed p50 step time. Semantics mirror bench.py's
+    `optimizer_efficiency` (throughput over the optimizer-free floor):
+    near 1 means the step runs at its roofline, and ANY step-time
+    regression — a de-fused sparse update, a new host sync, a slow
+    kernel — pulls the gauge down mid-run instead of waiting for the
+    next bench round. Publishes unknown while the floor gauge is
+    absent (the dense path publishes none)."""
+
+    def __init__(self, floor_gauge: str = "train/step_floor_ms",
+                 timer: str = "train/step_ms",
+                 name: str = "opt_efficiency",
+                 bad_below: float = 0.25):
+        super().__init__(name)
+        self.floor_gauge = floor_gauge
+        self.timer = timer
+        self.bad_below = bad_below
+
+    def evaluate(self, telemetry, now: float) -> None:
+        floor = telemetry.gauges.get(self.floor_gauge)
+        stat = telemetry.timers.get(self.timer)
+        if floor is None or not _is_finite(floor) or float(floor) <= 0:
+            self._publish(telemetry, float("nan"), "unknown",
+                          "no step-floor gauge published")
+            return
+        if stat is None or stat.count == 0:
+            self._publish(telemetry, float("nan"), "unknown",
+                          "no step samples yet")
+            return
+        p50 = stat.percentile(50)
+        if p50 <= 0:
+            self._publish(telemetry, self.value, self.status,
+                          "zero p50")
+            return
+        eff = min(1.0, float(floor) / p50)
+        self._publish(telemetry, eff,
+                      "bad" if eff < self.bad_below else "ok")
+
+
 def default_train_monitors() -> List[Monitor]:
     """The train-loop set: non-finite loss, loss spike, throughput
-    regression, infeed starvation. Raw inputs are the gauges/timers
-    both train loops already publish through TrainStepRecorder."""
+    regression, infeed starvation, analytic-floor attainment. Raw
+    inputs are the gauges/timers both train loops already publish
+    through TrainStepRecorder (+ the sparse path's static floor
+    gauge)."""
     return [
         NonFiniteGauges(("train/loss",), name="loss_nonfinite"),
         EwmaZScore("train/loss", name="loss_spike_z"),
         CounterRate("train/examples", name="throughput"),
         TimerShare(name="infeed_starvation"),
+        OptEfficiency(name="opt_efficiency"),
     ]
 
 

@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from code2vec_tpu.ops.quant import _SCALE_FLOOR, QuantTable
+from code2vec_tpu.ops.quant import (_SCALE_FLOOR, QuantTable,
+                                    dither_from_index)
 
 # Rows per program. int8's min TPU tile is (32, 128); 256 rows x E=128
 # keeps the three per-block buffers (q int8 + update + f32 temps) well
@@ -61,13 +62,7 @@ def _requant_kernel(salt_ref, q_ref, s_ref, upd_ref, qo_ref, so_ref, *,
     rows = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, emb), 0)
     cols = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, emb), 1)
     idx = (row0 + rows) * jnp.uint32(emb) + cols
-    h = (idx ^ salt) * jnp.uint32(2654435761)
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(2246822519)
-    h = h ^ (h >> 13)
-    # top 24 bits -> f32 (exact in a 24-bit mantissa; see _dither)
-    dither = ((h >> 8).astype(jnp.float32)
-              * jnp.float32(1.0 / 16777216.0) - 0.5)
+    dither = dither_from_index(idx, salt)  # the shared counter-hash
     qo_ref[:] = jnp.clip(jnp.round(x + dither), -127, 127).astype(jnp.int8)
     so_ref[:] = s_new
 

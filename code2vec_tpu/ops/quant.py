@@ -142,6 +142,26 @@ def opt_param_view(params):
             for k, v in params.items()}
 
 
+def dither_from_index(idx: jax.Array, salt: jax.Array) -> jax.Array:
+    """Uniform(-0.5, 0.5) dither for uint32 element indices `idx` under
+    a uint32 `salt` — THE counter-hash stream (salted xxhash-style
+    finalizer; see _dither for why not threefry). Single source of
+    truth shared by the dense reference (_dither), the fused requantize
+    kernel (ops/pallas_requant.py) and the sparse live-row update
+    (training/sparse_update.py + ops/pallas_sparse_update.py): all four
+    must draw the SAME value for the same absolute [V, E] element index
+    and salt, or fused-vs-reference q parity breaks."""
+    h = (idx ^ salt) * jnp.uint32(2654435761)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(2246822519)
+    h = h ^ (h >> 13)
+    # top 24 bits -> f32: exact in a 24-bit mantissa, so the result
+    # stays in [-0.5, 0.5) — a full-32-bit convert would round values
+    # near 2^32 up and emit dither of exactly +0.5
+    return ((h >> 8).astype(jnp.float32) * jnp.float32(1.0 / 16777216.0)
+            - 0.5)
+
+
 def _dither(rng: jax.Array, shape) -> jax.Array:
     """Uniform(-0.5, 0.5) dither from a fused counter hash, NOT
     jax.random.uniform: threefry bits for a [V, E] table are ~283M
@@ -159,15 +179,7 @@ def _dither(rng: jax.Array, shape) -> jax.Array:
     for d in shape:
         n *= d
     idx = jax.lax.iota(jnp.uint32, n).reshape(shape)
-    h = (idx ^ salt) * jnp.uint32(2654435761)
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(2246822519)
-    h = h ^ (h >> 13)
-    # top 24 bits -> f32: exact in a 24-bit mantissa, so the result
-    # stays in [-0.5, 0.5) — a full-32-bit convert would round values
-    # near 2^32 up and emit dither of exactly +0.5
-    return ((h >> 8).astype(jnp.float32) * jnp.float32(1.0 / 16777216.0)
-            - 0.5)
+    return dither_from_index(idx, salt)
 
 
 def requantize_reference(qt: QuantTable, update: jax.Array,
@@ -206,13 +218,18 @@ def requantize(qt: QuantTable, update: jax.Array, rng: jax.Array, *,
     return requantize_reference(qt, update, rng)
 
 
-def resolve_requant_mode(mode: str):
-    """Config.REQUANT_PALLAS -> the `fused` argument of requantize():
-    "auto" -> None (backend auto-select), "fused" -> True,
-    "reference" -> False. Config.verify() rejects anything else; this
-    raises for programmatic users bypassing verify()."""
+def resolve_tristate_mode(mode: str, flag: str):
+    """The shared auto|fused|reference -> None|True|False mapping for
+    kernel-dispatch config flags ("auto" = backend auto-select).
+    Config.verify() rejects anything else; this raises for programmatic
+    users bypassing verify(). `flag` names the offender in the error."""
     try:
         return {"auto": None, "fused": True, "reference": False}[mode]
     except KeyError:
         raise ValueError(
-            f"REQUANT_PALLAS must be auto|fused|reference, got {mode!r}")
+            f"{flag} must be auto|fused|reference, got {mode!r}")
+
+
+def resolve_requant_mode(mode: str):
+    """Config.REQUANT_PALLAS -> the `fused` argument of requantize()."""
+    return resolve_tristate_mode(mode, "REQUANT_PALLAS")

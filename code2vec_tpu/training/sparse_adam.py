@@ -1,18 +1,24 @@
-"""Sparse-row (lazy) Adam for embedding tables.
+"""Sparse-row (lazy) Adam state + the dense-carrier oracle update.
 
-SURVEY.md §8.4 item 2: dense embedding gradients dominate java-large step
-time — Adam over the full token/path/target tables reads+writes ~9 GB of
-HBM per step (measured 45 ms/step on one v5e chip). Only a few hundred
-thousand rows are touched per batch, so moments and parameters are
-updated for TOUCHED ROWS ONLY:
+SURVEY.md §8.4 item 2: table traffic dominates the java-large step, and
+a batch touches far fewer than V unique rows — BENCH_r05 puts the
+shipped dense path at 6.66M pc/s/chip against an 8.48M fwd/bwd floor
+(optimizer efficiency 0.786, HBM at 15.7% of the 637 GB/s ceiling), so
+moments and parameters are updated for TOUCHED ROWS ONLY. (The "45 ms
+dense / ~9 GB moment traffic" figures previously quoted here were
+pre-round-3 Adam-table measurements; adafactor tables + bf16 storage
+retired them — BENCH_r*.json is the trajectory of record.)
 
-  scatter-ADD cotangents into a dense [V, E] gradient-sum buffer (the
-  VJP of a gather) -> gather the summed gradients, m/v, and params at
-  the touched ids -> per-row Adam -> scatter-SET rows back (duplicates
-  of a row write identical values, so the sets are idempotent).
-
-Everything is static-shaped (N = number of gathered rows per step), so
-the step jits once and XLA maps the gather/scatter onto the TPU.
+The production path is training/sparse_update.py (round 13): dedup +
+segment-sum into a COMPACT [U, E] gradient, then a live-rows-only
+row-Adam / requantize-aware apply — fused into one Pallas pass over the
+live rows on TPU (`--sparse_update_pallas`), XLA reference elsewhere.
+`row_adam_update` below is the ORIGINAL dense-carrier form (scatter-ADD
+cotangents into a dense [V, E] buffer — the VJP of a gather — gather
+back at the touched ids, per-row Adam, idempotent scatter-SET): it
+survives as the bit-parity oracle the compact path is property-tested
+against (tests/test_sparse_update.py) and for A/B attribution of the
+carrier's cost.
 
 Semantics note (documented deviation): TF1's AdamOptimizer._apply_sparse
 decays m/v over ALL rows each step (which is exactly the dense traffic we
@@ -31,12 +37,19 @@ import jax.numpy as jnp
 
 
 class RowAdamState(NamedTuple):
-    m: jax.Array  # [V, E] first moment (same shape as the table)
+    m: jax.Array  # [V, E] first moment (same rows as the table)
     v: jax.Array  # [V, E] second moment
 
 
-def init_row_adam(table: jax.Array) -> RowAdamState:
-    return RowAdamState(m=jnp.zeros_like(table), v=jnp.zeros_like(table))
+def init_row_adam(table) -> RowAdamState:
+    """Zero moments for a table — f32 regardless of storage dtype
+    (bf16 moments would lose the low accumulation bits Adam needs;
+    int8 {q, s} tables get moments shaped like q). Moment rows are
+    only ever read/written at touched ids, so the f32 cost is HBM
+    capacity, not step traffic."""
+    shape = table["q"].shape if isinstance(table, dict) else table.shape
+    return RowAdamState(m=jnp.zeros(shape, jnp.float32),
+                        v=jnp.zeros(shape, jnp.float32))
 
 
 def row_adam_update(table: jax.Array, state: RowAdamState,

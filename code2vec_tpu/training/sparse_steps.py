@@ -4,13 +4,27 @@ Same math as training/steps.make_train_step, restructured so the three
 vocab tables are differentiated at the GATHERED-ROW level: the gathers
 happen outside the differentiated function, autodiff produces cotangents
 for the gathered [rows, E] arrays directly (no dense-table scatter in the
-backward pass), and sparse_adam applies touched-rows-only Adam. Dense
-params (TRANSFORM / ATTENTION — and TARGET_WORDS_VOCAB when running full
-softmax, whose logits touch every row anyway) keep ordinary optax Adam.
+backward pass), and the sparse-update facade
+(training/sparse_update.py, round 13) dedups + segment-sums those
+cotangents into a compact [U, E] gradient and applies touched-rows-only
+Adam — no dense [V, E] carrier anywhere, and on int8 {q, s} tables a
+requantize-aware row update reusing the ops/pallas_requant dither/absmax
+machinery. Dense params (TRANSFORM / ATTENTION — and TARGET_WORDS_VOCAB
+when running full softmax, whose logits touch every row anyway) keep
+ordinary optax Adam.
 
-Step time on java-large (1 chip, batch 1024): 45 ms dense -> see bench.py
-for the sparse number; the dense-Adam moment traffic (~9 GB/step) is
-replaced by ~1 GB of gather/scatter on touched rows.
+Why: BENCH_r05 measures the shipped dense-path step at 6.66M pc/s/chip
+against an 8.48M fwd/bwd floor (optimizer efficiency 0.786, HBM at
+15.7% of the 637 GB/s ceiling) — the gap IS the dense backward scatter
+plus the table-proportional optimizer walk this module avoids. The
+round-6 lesson (the fused requantize row-pass turned the int8 +26%
+step-time tax into ~0) repeats one level up: `--sparse_update_pallas`
+selects the fused Pallas live-row kernel on a single-device TPU and the
+XLA segment-sum reference on CPU (meshes keep the dense-carrier
+apply — see the use_carrier gate below); bench.py attributes the phase
+every round (`sparse_update_*`). The pre-round-6 "45 ms dense" numbers
+previously quoted here predate the adafactor default and the bf16
+tables — BENCH_r*.json is the trajectory of record.
 """
 
 from __future__ import annotations
@@ -23,10 +37,12 @@ import optax
 
 from code2vec_tpu.models.encoder import ModelDims
 from code2vec_tpu.ops.attention import attention_pool
+from code2vec_tpu.ops.quant import is_quantized
 from code2vec_tpu.ops.sampled_softmax import (
     _log_expected_count, log_uniform_sample)
-from code2vec_tpu.training.sparse_adam import (init_row_adam,
-                                               row_adam_update)
+from code2vec_tpu.training.sparse_adam import init_row_adam
+from code2vec_tpu.training.sparse_update import (sparse_requant_adam,
+                                                 sparse_row_adam)
 
 
 def init_sparse_opt_state(params: Dict[str, jax.Array],
@@ -44,6 +60,19 @@ def init_sparse_opt_state(params: Dict[str, jax.Array],
             "count": jnp.zeros((), jnp.int32)}
 
 
+def _gather_rows(table, ids):
+    """Row gather in the dtype autodiff differentiates: plain tables
+    as-is; int8 {q, s} dequantize AFTER the gather to bf16 (q*s carries
+    <= 8 significant bits — same rationale as ops/quant.quantized_take,
+    but no straight-through carrier: the rows themselves are the
+    differentiated leaves here)."""
+    if is_quantized(table):
+        rows = (jnp.take(table["q"], ids, axis=0).astype(jnp.float32)
+                * jnp.take(table["s"], ids, axis=0))
+        return rows.astype(jnp.bfloat16)
+    return jnp.take(table, ids, axis=0)
+
+
 def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
                            dense_optimizer: optax.GradientTransformation
                            | None = None,
@@ -52,23 +81,51 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
                            compute_dtype=jnp.float32,
                            use_pallas: bool = False,
                            b1: float = 0.9, b2: float = 0.999,
-                           eps: float = 1e-8) -> Callable:
+                           eps: float = 1e-8,
+                           sparse_update_fused=None,
+                           sparse_block_rows: int | None = None,
+                           mesh=None) -> Callable:
     """Returns jitted `step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)`; opt_state from init_sparse_opt_state.
 
     `dense_optimizer` must be the SAME transformation passed to
     init_sparse_opt_state (single source of truth for the dense-param
     hyperparameters); `learning_rate`/`b1`/`b2`/`eps` govern only the
-    row-sparse table updates and should match it."""
+    row-sparse table updates and should match it. `sparse_update_fused`
+    selects the live-row implementation on single-device runs
+    (sparse_update facade: None = Pallas kernel on TPU, XLA reference
+    on CPU); under a mesh it is NOT consulted — the step keeps the
+    dense-carrier apply (f32 tables only; see the use_carrier gate)."""
     dense_opt = dense_optimizer if dense_optimizer is not None else \
         optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
     S = min(num_sampled, dims.target_vocab_size)
     V = dims.target_vocab_size
 
+    # Under a mesh the tables keep the DENSE-CARRIER apply
+    # (sparse_adam.row_adam_update — the pre-round-13 form, behavior
+    # unchanged by this round): the compact path's dedup composition
+    # (jnp.unique + segment scatter into a batch-sized buffer)
+    # MISCOMPILES under GSPMD on the virtual CPU mesh (measured:
+    # wrong segment sums for sharded inputs, round 13), and the
+    # per-row DMA kernel inside a partitioned step is equally
+    # unexercised — one rule, one gate; SPARSE_UPDATE_PALLAS is NOT
+    # consulted here. Sharded INPUTS into a step built with mesh=None
+    # hit the same miscompile: callers must pass the mesh they shard
+    # with. Known caveat carried from seed: the carrier form's own
+    # mesh-vs-single-device parity test (test_sparse_adam.py) FAILS
+    # on this virtual-CPU-mesh platform at pristine HEAD too — the
+    # GSPMD table-scatter numerics issue is ROADMAP item 2's
+    # burn-down, not something this gate introduces or fixes.
+    use_carrier = mesh is not None
+
     def step_impl(params, opt_state, batch, rng):
         labels, src, pth, dst, mask, weights = batch
         B, C = src.shape
-        drop_rng, sample_rng = jax.random.split(rng)
+        qkeys = sorted(k for k in ("token_emb", "path_emb")
+                       if is_quantized(params[k]))
+        drop_rng, sample_rng, *qrngs = jax.random.split(
+            rng, 2 + len(qkeys))
+        qrngs = dict(zip(qkeys, qrngs))
 
         # ---- non-differentiated preliminaries ----
         if use_sampled_softmax:
@@ -78,15 +135,15 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
             accidental = sampled[None, :] == labels[:, None]        # [B,S]
 
         # ---- gathers OUTSIDE the differentiated function ----
-        src_e = jnp.take(params["token_emb"], src, axis=0)
-        dst_e = jnp.take(params["token_emb"], dst, axis=0)
-        pth_e = jnp.take(params["path_emb"], pth, axis=0)
+        src_e = _gather_rows(params["token_emb"], src)
+        dst_e = _gather_rows(params["token_emb"], dst)
+        pth_e = _gather_rows(params["path_emb"], pth)
         gathered = {"src_e": src_e, "pth_e": pth_e, "dst_e": dst_e}
         if use_sampled_softmax:
-            gathered["true_w"] = jnp.take(params["target_emb"], labels,
-                                          axis=0)
-            gathered["samp_w"] = jnp.take(params["target_emb"], sampled,
-                                          axis=0)
+            gathered["true_w"] = _gather_rows(params["target_emb"],
+                                              labels)
+            gathered["samp_w"] = _gather_rows(params["target_emb"],
+                                              sampled)
 
         dense_keys = ["transform", "attention"]
         if not use_sampled_softmax:
@@ -136,20 +193,52 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
             g_dense, opt_state["dense"], dense)
         dense = optax.apply_updates(dense, updates)
 
-        # ---- tables: touched-rows-only Adam ----
+        # ---- tables: dedup + segment-sum + live-rows-only update
+        # (training/sparse_update.py — no dense [V, E] carrier) ----
         E = dims.embeddings_size
+
+        def apply_rows(key, ids, grads):
+            table, state = params[key], opt_state["rows"][key]
+            if use_carrier:
+                if is_quantized(table):
+                    raise ValueError(
+                        "sparse updates on int8 tables are "
+                        "single-device only (the mesh path keeps the "
+                        "dense-carrier apply, which has no {q, s} "
+                        "form)")
+                if table.dtype != jnp.float32:
+                    # the carrier form accumulates duplicate-row
+                    # cotangents in the TABLE dtype and scatter-SETs
+                    # f32 Adam output back — on bf16 that both loses
+                    # accumulation bits the compact path keeps (f32
+                    # segment sums) and hits the scatter dtype-
+                    # mismatch XLA is deprecating
+                    raise ValueError(
+                        "sparse updates under a mesh require float32 "
+                        f"tables (got {table.dtype} for {key!r}; the "
+                        "mesh path keeps the SPMD-proven dense-"
+                        "carrier apply, which is f32-only — bf16/int8 "
+                        "sparse tables are single-device)")
+                from code2vec_tpu.training.sparse_adam import \
+                    row_adam_update
+                return row_adam_update(table, state, ids.reshape(-1),
+                                       grads, count=count,
+                                       lr=learning_rate, b1=b1, b2=b2,
+                                       eps=eps)
+            kw = dict(count=count, lr=learning_rate, b1=b1, b2=b2,
+                      eps=eps, fused=sparse_update_fused,
+                      block_rows=sparse_block_rows)
+            if is_quantized(table):
+                return sparse_requant_adam(table, state, ids, grads,
+                                           qrngs[key], **kw)
+            return sparse_row_adam(table, state, ids, grads, **kw)
+
         tok_ids = jnp.concatenate([src.reshape(-1), dst.reshape(-1)])
         tok_g = jnp.concatenate([g_rows["src_e"].reshape(-1, E),
                                  g_rows["dst_e"].reshape(-1, E)])
-        new_tok, tok_state = row_adam_update(
-            params["token_emb"], opt_state["rows"]["token_emb"], tok_ids,
-            tok_g, count=count, lr=learning_rate, b1=b1, b2=b2, eps=eps,
-            vocab_size=dims.padded(dims.token_vocab_size))
-        new_pth, pth_state = row_adam_update(
-            params["path_emb"], opt_state["rows"]["path_emb"],
-            pth.reshape(-1), g_rows["pth_e"].reshape(-1, E), count=count,
-            lr=learning_rate, b1=b1, b2=b2, eps=eps,
-            vocab_size=dims.padded(dims.path_vocab_size))
+        new_tok, tok_state = apply_rows("token_emb", tok_ids, tok_g)
+        new_pth, pth_state = apply_rows("path_emb", pth.reshape(-1),
+                                        g_rows["pth_e"].reshape(-1, E))
 
         new_params = dict(params)
         new_params["token_emb"] = new_tok
@@ -162,11 +251,8 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
             tgt_ids = jnp.concatenate([labels, sampled])
             tgt_g = jnp.concatenate([g_rows["true_w"].reshape(-1, D),
                                      g_rows["samp_w"].reshape(-1, D)])
-            new_tgt, tgt_state = row_adam_update(
-                params["target_emb"], opt_state["rows"]["target_emb"],
-                tgt_ids, tgt_g, count=count, lr=learning_rate, b1=b1,
-                b2=b2, eps=eps,
-                vocab_size=dims.padded(dims.target_vocab_size))
+            new_tgt, tgt_state = apply_rows("target_emb", tgt_ids,
+                                            tgt_g)
             new_params["target_emb"] = new_tgt
             new_rows["target_emb"] = tgt_state
         else:

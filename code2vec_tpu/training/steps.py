@@ -75,7 +75,11 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
                     use_pallas: bool = False,
                     mesh=None,
                     augment_fn: Callable = None,
-                    requant_fused: bool = None) -> Callable:
+                    requant_fused: bool = None,
+                    sparse_updates: bool = False,
+                    learning_rate: float | None = None,
+                    sparse_update_fused=None,
+                    sparse_block_rows: int | None = None) -> Callable:
     """Returns jitted `step(params, opt_state, batch, rng) ->
     (params, opt_state, loss)` where batch is a 6-tuple of arrays
     (labels [B], src/path/dst ids [B, C], mask [B, C],
@@ -86,7 +90,33 @@ def make_train_step(dims: ModelDims, optimizer: optax.GradientTransformation,
     requantize implementation (ops/quant.requantize: None = fused
     Pallas row-pass on single-device TPU, XLA reference elsewhere —
     incl. under a mesh, where the kernel-in-GSPMD composition is
-    unexercised); ignored for float/bf16 tables."""
+    unexercised); ignored for float/bf16 tables.
+
+    `sparse_updates=True` (Config.SPARSE_EMBEDDING_UPDATES) dispatches
+    to training/sparse_steps.make_sparse_train_step — gathered-row
+    differentiation + the dedup/segment-sum/live-row facade
+    (training/sparse_update.py), with `sparse_update_fused` /
+    `sparse_block_rows` (Config.SPARSE_UPDATE_PALLAS) selecting the
+    Pallas live-row kernel vs the XLA reference; opt_state must then
+    come from sparse_steps.init_sparse_opt_state and `learning_rate`
+    names the tables' row-Adam LR. This keeps ONE step-construction
+    entry point for models/jax_model.py and bench.py."""
+    if sparse_updates:
+        assert augment_fn is None, (
+            "sparse_updates has no augmentation hook "
+            "(Config.verify gates --adv_rename_prob)")
+        assert learning_rate is not None, (
+            "sparse_updates needs the tables' learning_rate")
+        from code2vec_tpu.training.sparse_steps import \
+            make_sparse_train_step
+        return make_sparse_train_step(
+            dims, learning_rate=learning_rate,
+            dense_optimizer=optimizer,
+            use_sampled_softmax=use_sampled_softmax,
+            num_sampled=num_sampled, compute_dtype=compute_dtype,
+            use_pallas=use_pallas,
+            sparse_update_fused=sparse_update_fused,
+            sparse_block_rows=sparse_block_rows, mesh=mesh)
 
     loss_fn = make_train_loss_fn(
         dims, use_sampled_softmax=use_sampled_softmax,

@@ -97,6 +97,48 @@ def test_cli_exit_codes_and_json():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_mixed_schema_history_gates_each_round_on_its_own_fields():
+    """ISSUE 8 satellite: rounds predating the round-13 `sparse_*`
+    fields must not crash the gate — they drop out of the sparse
+    metric's history (gating there starts once 2+ rounds report it)
+    while `value` stays gated across the whole trajectory; booleans
+    and non-numeric placeholders never enter a series."""
+    rc, rows = run(os.path.join(FIXTURES, "mixed"),
+                   ["value", "sparse_pc_per_sec", "sparse_update_ms",
+                    "sparse_update_fused"],
+                   band=0.05, window=5, min_history=2, strict=False)
+    assert rc == 0
+    by = {r["metric"]: r for r in rows}
+    # value: gated over ALL five rounds
+    assert by["value"]["status"] == "ok"
+    assert by["value"]["history_rounds"] == [1, 2, 3, 4]
+    # sparse_pc_per_sec: only r03/r04 form history (r01/r02 predate it)
+    assert by["sparse_pc_per_sec"]["status"] == "ok"
+    assert by["sparse_pc_per_sec"]["history_rounds"] == [3, 4]
+    # latest carries a non-numeric placeholder -> skip, not a crash,
+    # and the note names the real cause (key present, value unusable)
+    assert by["sparse_update_ms"]["status"] == "skip"
+    assert by["sparse_update_ms"]["note"] == "non-numeric in latest round"
+    # booleans are flags, not gauges -> never gated
+    assert by["sparse_update_fused"]["status"] == "skip"
+
+
+def test_mixed_schema_latest_predates_metric_skips():
+    """A metric the LATEST round doesn't report is a skip even when
+    old rounds had it (r05 lacks nothing here, so gate a phantom)."""
+    rc, rows = run(os.path.join(FIXTURES, "mixed"),
+                   ["sparse_update_unique_rows"],
+                   band=0.05, window=5, min_history=2, strict=False)
+    assert rc == 0
+    assert rows[0]["status"] == "skip"
+    assert rows[0]["note"] == "absent from latest round"
+
+
+def test_default_metrics_include_sparse_gate():
+    from tools.bench_regression import DEFAULT_METRICS
+    assert "sparse_pc_per_sec" in DEFAULT_METRICS
+
+
 def test_repo_trajectory_is_loadable():
     """The real BENCH_r*.json history stays parseable by the gate (the
     driver runs it against exactly these files)."""

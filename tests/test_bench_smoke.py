@@ -50,12 +50,26 @@ def test_main_emits_one_valid_json_line(monkeypatch, capsys):
                 # acceptance contract is these fields present off-TPU
                 "int8_hbm_gbps", "int8_requant_ms", "int8_requant_bytes",
                 "int8_requant_gbps", "int8_requant_floor_ms",
-                "int8_requant_vs_ceiling", "int8_requant_fused"):
+                "int8_requant_vs_ceiling", "int8_requant_fused",
+                # sparse table-update attribution (round 13): same
+                # present-off-TPU contract
+                "sparse_pc_per_sec", "sparse_ms_per_step",
+                "sparse_hbm_gbps", "sparse_step_floor_pc_per_sec",
+                "sparse_optimizer_efficiency", "sparse_update_ms",
+                "sparse_update_bytes", "sparse_update_gbps",
+                "sparse_update_floor_ms", "sparse_update_vs_ceiling",
+                "sparse_update_unique_rows", "sparse_update_fused"):
         assert key in j, key
     assert j["metric"] == "path-contexts/sec/chip"
     assert np.isfinite(j["value"])
     assert j["int8_requant_fused"] is False  # CPU -> reference path
     assert j["int8_requant_bytes"] > 0
+    assert j["sparse_update_fused"] is False  # CPU -> reference path
+    assert j["sparse_update_bytes"] > 0
+    # per-table uniques are bounded by each vocab (the id draws cover
+    # the tiny vocabs almost fully: _device_batches' max_contexts
+    # default binds the REAL 200 at import time, not the patched 6)
+    assert 0 < j["sparse_update_unique_rows"] <= 128 + 96 + 64
 
 
 def test_step_hbm_bytes_counts_quantized_carrier():

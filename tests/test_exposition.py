@@ -205,6 +205,9 @@ def test_stop_releases_port(populated):
 
 def test_obs_top_once_renders_live_table(populated, capsys):
     populated.gauge("train/max_contexts", 16, emit=False)
+    # the health engine's derived gauge (round 13): obs_top surfaces
+    # it as the per-host "opt eff" column
+    populated.gauge("health/opt_efficiency", 0.913, emit=False)
     srv = MetricsServer(populated, port=0).start()
 
     # bump the counters between obs_top's two polls so rates are real
@@ -226,6 +229,7 @@ def test_obs_top_once_renders_live_table(populated, capsys):
         assert "1/1 hosts up" in out
         # 160 ex over ~0.4s x 16 contexts: a positive live pc/s figure
         assert "| ok |" in out
+        assert "opt eff" in out and "0.913" in out
     finally:
         srv.stop()
 

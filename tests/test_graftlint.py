@@ -46,8 +46,9 @@ def test_repo_lints_clean_against_baseline(repo_findings):
 
 def test_serving_and_obs_trees_are_finding_free(repo_findings):
     """ISSUE 4 acceptance (extended to training/ with the async
-    checkpoint writer): EMPTY baseline for the no-baseline trees — and
-    not just baselined-away: zero findings at all."""
+    checkpoint writer, ops/ with the fused sparse-update kernel):
+    EMPTY baseline for the no-baseline trees — and not just
+    baselined-away: zero findings at all."""
     dirty = [f for f in repo_findings
              if f.path.startswith(baseline_mod.NO_BASELINE_PREFIXES)]
     assert dirty == [], "\n".join(f.render() for f in dirty)
@@ -187,9 +188,12 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     bad_training = Finding("lock-discipline",
                            "code2vec_tpu/training/checkpoint.py",
                            1, "m", "s")
+    bad_ops = Finding("host-sync-in-hot-path",
+                      "code2vec_tpu/ops/pallas_sparse_update.py",
+                      1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
-    refused = baseline_mod.write([bad, bad_training, ok], path)
-    assert refused == [bad, bad_training]
+    refused = baseline_mod.write([bad, bad_training, bad_ops, ok], path)
+    assert refused == [bad, bad_training, bad_ops]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 

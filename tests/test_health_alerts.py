@@ -19,8 +19,8 @@ from code2vec_tpu.obs.alerts import (default_serving_rules,
                                      default_train_rules)
 from code2vec_tpu.obs.health import (CounterRate, CounterRatio,
                                      EwmaZScore, HealthEngine,
-                                     NonFiniteGauges, TimerShare,
-                                     default_train_monitors)
+                                     NonFiniteGauges, OptEfficiency,
+                                     TimerShare, default_train_monitors)
 
 
 # ---- monitors ----
@@ -165,6 +165,41 @@ def test_counter_ratio_cache_hit_and_shed():
     # a quiet window (below min_events) keeps the last verdict
     shed.evaluate(t, 3.0)
     assert shed.status == "bad"
+
+
+def test_opt_efficiency_floor_over_observed_p50():
+    """ISSUE 8 satellite: the live optimizer-efficiency gauge = the
+    sparse path's static [U, E]-aware floor gauge over observed p50
+    step time — unknown until BOTH exist, capped at 1, bad below the
+    threshold when the step slows down mid-run."""
+    t = Telemetry.memory("m")
+    mon = OptEfficiency(name="opt_efficiency")
+    mon.evaluate(t, 0.0)  # neither floor nor samples yet
+    assert mon.status == "unknown"
+    t.gauge("train/step_floor_ms", 8.0, emit=False, static=True)
+    mon.evaluate(t, 1.0)  # floor but no step samples
+    assert mon.status == "unknown"
+    for _ in range(5):
+        t.record_ms("train/step_ms", 10.0)
+    mon.evaluate(t, 2.0)
+    assert t.gauges["health/opt_efficiency"] == pytest.approx(0.8)
+    assert mon.status == "ok"
+    # step regresses 10 ms -> 40 ms: efficiency collapses below bad
+    for _ in range(20):
+        t.record_ms("train/step_ms", 40.0)
+    mon.evaluate(t, 3.0)
+    assert t.gauges["health/opt_efficiency"] < 0.25
+    assert mon.status == "bad"
+    # a step FASTER than the analytic floor caps at 1, never > 1
+    t2 = Telemetry.memory("m2")
+    t2.gauge("train/step_floor_ms", 8.0, emit=False, static=True)
+    t2.record_ms("train/step_ms", 2.0)
+    mon2 = OptEfficiency(name="opt_efficiency")
+    mon2.evaluate(t2, 0.0)
+    assert t2.gauges["health/opt_efficiency"] == 1.0
+    # the default train set carries it
+    assert any(m.name == "opt_efficiency"
+               for m in default_train_monitors())
 
 
 def test_broken_monitor_does_not_kill_sweep():

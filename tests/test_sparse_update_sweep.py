@@ -1,0 +1,52 @@
+"""tools/sparse_update_sweep.py: the block-size x id-count x vocab
+kernel-tuning sweep is `slow`-marked so tier-1 (`-m 'not slow'`,
+ROADMAP.md) never pays for it; the marker-registration guard itself IS
+tier-1 so an unregistered/typo'd marker cannot silently drop the
+deselection (the requant_sweep pattern)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load_sweep():
+    spec = importlib.util.spec_from_file_location(
+        "sparse_update_sweep",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools",
+            "sparse_update_sweep.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slow_marker_registered(request):
+    """The tier-1 command deselects with -m 'not slow'; that only
+    reliably matches a REGISTERED marker (pytest.ini)."""
+    markers = request.config.getini("markers")
+    assert any(str(m).startswith("slow:") for m in markers), markers
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_sparse_update_sweep_tiny_grid(capsys, tmp_path, dtype):
+    out = str(tmp_path / "sweep.jsonl")
+    _load_sweep().main(["--vocabs", "64", "--blocks", "32", "--emb",
+                        "8", "--ids", "128", "--dtype", dtype,
+                        "--steps", "2", "--out", out])
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1
+    row = json.loads(lines[0])
+    for key in ("vocab", "n_ids", "block_rows", "dtype", "unique_rows",
+                "fused_ms", "reference_ms", "update_bytes",
+                "fused_gbps", "mode"):
+        assert key in row, key
+    assert row["vocab"] == 64 and row["block_rows"] == 32
+    assert row["dtype"] == dtype
+    assert 0 < row["unique_rows"] <= 64
+    with open(out, encoding="utf-8") as f:
+        assert json.loads(f.readline())["update_bytes"] \
+            == row["update_bytes"]

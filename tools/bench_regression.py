@@ -35,9 +35,14 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 # higher-is-better figures gated by default; ms_per_step & friends are
-# redundant inverses of these
+# redundant inverses of these. Schema growth rule: rounds predating a
+# metric (e.g. the round-13 `sparse_*` family) simply lack the key —
+# they are excluded from that metric's history and the LATEST round
+# gates on the metrics it actually reports (older rounds effectively
+# gate on `value` and whatever else they carry); a missing or
+# non-numeric key is never fatal to the gate.
 DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
-                   "fwd_bwd_floor_pc_per_sec")
+                   "fwd_bwd_floor_pc_per_sec", "sparse_pc_per_sec")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -69,6 +74,19 @@ def load_rounds(dir_path: str, pattern: str = "BENCH_r*.json"
         rounds.append((int(m.group(1)), result))
     rounds.sort()
     return rounds
+
+
+def _num(res: Dict[str, Any], metric: str) -> Optional[float]:
+    """The metric's finite numeric value, or None when the round
+    predates the metric (mixed-schema history) or carries a
+    non-numeric placeholder — either way the round is excluded from
+    this metric's series instead of crashing the gate."""
+    v = res.get(metric)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    v = float(v)
+    return v if v == v and v not in (float("inf"), float("-inf")) \
+        else None
 
 
 def _median(vals: List[float]) -> float:
@@ -115,16 +133,19 @@ def run(dir_path: str, metrics: List[str], band: float, window: int,
     prior = rounds[:-1]
     rows = []
     for metric in metrics:
-        if metric not in latest:
+        latest_val = _num(latest, metric)
+        if latest_val is None:
             rows.append({"metric": metric, "round": latest_round,
                          "status": "skip",
-                         "note": "absent from latest round"})
+                         "note": ("non-numeric in latest round"
+                                  if metric in latest
+                                  else "absent from latest round")})
             continue
-        history = [(r, float(res[metric])) for r, res in prior
-                   if metric in res][-window:]
+        history = [(r, v) for r, res in prior
+                   for v in [_num(res, metric)]
+                   if v is not None][-window:]
         rows.append(check_metric(metric, history, latest_round,
-                                 float(latest[metric]), band,
-                                 min_history))
+                                 latest_val, band, min_history))
     regressed = [r for r in rows if r["status"] == "REGRESSION"]
     skipped = [r for r in rows if r["status"] == "skip"]
     if strict and len(skipped) == len(rows):

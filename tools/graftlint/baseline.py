@@ -33,9 +33,11 @@ from tools.graftlint.core import Finding, REPO_ROOT
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
 
 # baselining is forbidden under these trees (ISSUE 4 acceptance;
-# training/ added with the async checkpoint writer — ISSUE 5)
+# training/ added with the async checkpoint writer — ISSUE 5; ops/
+# with the fused sparse-update kernel — ISSUE 8: every kernel ships
+# lint-clean, no grandfathering)
 NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/",
-                        "code2vec_tpu/training/")
+                        "code2vec_tpu/training/", "code2vec_tpu/ops/")
 
 
 def _entry(f: Finding) -> Dict[str, str]:

@@ -136,6 +136,11 @@ class EndpointState:
                      if ex_rate is not None and max_ctx else None),
             "step_p50": labeled(metrics, "train_step_ms",
                                 quantile="0.5"),
+            # analytic-floor attainment (health/opt_efficiency: the
+            # sparse path's static [U, E]-aware floor over observed
+            # p50 step time) — an optimizer-efficiency regression is
+            # a dropping number here, mid-run
+            "opt_eff": scalar(metrics, "health_opt_efficiency"),
             "infeed_p95": labeled(metrics, "train_infeed_wait_ms",
                                   quantile="0.95"),
             "req_s": rate("serve_requests"),
@@ -173,13 +178,13 @@ def render(rows: List[Dict[str, Any]]) -> str:
         f"{n_bad} host(s) unhealthy | "
         f"{time.strftime('%H:%M:%S')}")
     lines.append(
-        "| Host | steps | ex/s | pc/s | step p50 ms | infeed p95 ms "
-        "| req/s | q | loss | status |")
-    lines.append("|---|---|---|---|---|---|---|---|---|---|")
+        "| Host | steps | ex/s | pc/s | step p50 ms | opt eff "
+        "| infeed p95 ms | req/s | q | loss | status |")
+    lines.append("|---|---|---|---|---|---|---|---|---|---|---|")
     for r in rows:
         if "error" in r:
             lines.append(f"| {r['endpoint']} | DOWN: {r['error']} "
-                         "| | | | | | | | |")
+                         "| | | | | | | | | |")
             continue
         bits = []
         if r["stalled"]:
@@ -193,7 +198,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
         lines.append(
             f"| {r['endpoint']} | {_f(r['steps'], 0)} "
             f"| {_f(r['ex_s'])} | {_f(r['pc_s'])} "
-            f"| {_f(r['step_p50'], 2)} | {_f(r['infeed_p95'], 2)} "
+            f"| {_f(r['step_p50'], 2)} | {_f(r.get('opt_eff'), 3)} "
+            f"| {_f(r['infeed_p95'], 2)} "
             f"| {_f(r['req_s'])} | {_f(r['queue_depth'], 0)} "
             f"| {_f(r['loss'], 4)} "
             f"| {' '.join(bits) if bits else 'ok'} |")
