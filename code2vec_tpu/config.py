@@ -150,14 +150,15 @@ class Config:
     # (the round-5 baseline, kept for A/B attribution).
     REQUANT_PALLAS: str = "auto"  # "auto" | "fused" | "reference"
     # Sparse table-update implementation (only meaningful with
-    # --sparse_embeddings, single-device runs): "auto" = the fused
+    # --sparse_embeddings): "auto" = the fused
     # Pallas live-row kernel (ops/pallas_sparse_update.py) on a
     # single-device TPU backend, the XLA segment-sum reference on CPU;
     # "fused" forces the kernel (interpret mode off-TPU — the CPU test
     # path); "reference" forces the XLA form (the A/B numerics
-    # baseline). Under a MESH this flag is not consulted: the sparse
-    # step keeps the pre-round-13 dense-carrier apply (f32 tables
-    # only — sparse_steps.py documents the GSPMD gate).
+    # baseline). Honored under a MESH too (round 14): the compact
+    # dedup/segment-sum/live-row apply runs per device inside
+    # shard_map (sparse_update.mesh_sparse_apply) — no dense [V, E]
+    # carrier on the data-parallel path.
     SPARSE_UPDATE_PALLAS: str = "auto"  # "auto" | "fused" | "reference"
     # Measured single-chip HBM streaming ceiling (GB/s) — bench.py
     # re-measures the real value every round; this constant only feeds
@@ -536,8 +537,8 @@ class Config:
                             "--sparse_embeddings: fused Pallas "
                             "live-row kernel (auto on single-device "
                             "TPU) or the XLA segment-sum reference; "
-                            "not consulted under a mesh (dense-"
-                            "carrier apply, f32 tables only)")
+                            "honored under a mesh too (the kernel "
+                            "runs per device inside shard_map)")
         p.add_argument("--mesh_data", dest="mesh_data", type=int, default=None)
         p.add_argument("--mesh_model", dest="mesh_model", type=int, default=None)
         p.add_argument("--mesh_context", dest="mesh_context", type=int,

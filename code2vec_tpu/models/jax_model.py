@@ -26,7 +26,7 @@ from code2vec_tpu.data.reader import (BatchTensors, _pad_batch, open_reader,
 from code2vec_tpu.models.encoder import ModelDims, init_params
 from code2vec_tpu.models.model_base import Code2VecModelBase, MetricAccumulator
 from code2vec_tpu.parallel.distributed import fetch_global
-from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, MODEL_AXIS
 from code2vec_tpu.parallel.sharding import (shard_batch, shard_opt_state,
                                             shard_params)
 from code2vec_tpu.training import checkpoint as ckpt
@@ -163,20 +163,13 @@ class Code2VecModel(Code2VecModelBase):
                 raise ValueError(
                     "--tables_dtype int8 supports data-parallel meshes "
                     f"only; got mesh {shape}")
-        if cfg.SPARSE_EMBEDDING_UPDATES and self.mesh is not None \
-                and self.dims.tables_dtype != "float32":
-            # the mesh sparse step keeps the SPMD-proven dense-carrier
-            # apply, which is f32-only (bf16 would accumulate
-            # duplicate-row cotangents in bf16 and scatter f32 rows
-            # into a bf16 table; int8 has no carrier form). Same
-            # after-the-manifest-override placement as the int8 guard
-            # above; sparse_steps raises too — this is the model-level
-            # error with the flag names.
-            raise ValueError(
-                "--sparse_embeddings under a mesh requires "
-                "--tables_dtype float32 (the mesh path's dense-carrier "
-                f"apply is f32-only; got {self.dims.tables_dtype}); "
-                "bf16/int8 sparse tables are single-device")
+        # --sparse_embeddings under a mesh runs the compact
+        # dedup/segment-sum/live-row apply inside shard_map
+        # (sparse_update.mesh_sparse_apply, round 14) for f32/bf16 AND
+        # int8 tables — the round-13 f32-only dense-carrier restriction
+        # is gone with the carrier itself. int8 stays fenced to
+        # data-parallel meshes by the guard above (shared with the
+        # non-sparse quantized step).
 
         def n_train_examples() -> int:
             # dict pickle already carries the count; rescan the file
@@ -409,28 +402,44 @@ class Code2VecModel(Code2VecModelBase):
         # (static: a set-once config echo must not read as stale)
         telemetry.gauge("train/max_contexts", cfg.MAX_CONTEXTS,
                         emit=False, static=True)
-        if cfg.SPARSE_EMBEDDING_UPDATES and self.mesh is None:
+        model_shards = 1 if self.mesh is None else \
+            int(self.mesh.shape.get(MODEL_AXIS, 1))
+        if cfg.SPARSE_EMBEDDING_UPDATES and model_shards == 1:
             # live optimizer-efficiency plane (round 13): publish the
             # [U, E]-aware analytic step floor once; the health
             # engine's opt_efficiency monitor divides it by the
             # observed p50 step time every sweep, so a step-time
             # regression is visible on /metrics and tools/obs_top.py
             # mid-run, not just at bench time. (Static: analytic
-            # facts, not heartbeats. Single-device only: mesh sparse
-            # runs execute the dense-carrier apply, which this [U, E]
-            # model does not describe — publishing it there would
-            # read as a false 'bad' opt_efficiency; without the gauge
-            # the monitor correctly stays 'unknown'.)
+            # facts, not heartbeats. Data-parallel meshes publish the
+            # PER-DEVICE model — round 14: forward/backward
+            # per-occurrence traffic covers the device's batch shard,
+            # the apply phase covers the all-gathered GLOBAL list
+            # mesh_sparse_apply replicates — which is the standing
+            # assertion that no dense [V, E] carrier exists on the
+            # data-parallel sparse path. Row-sharded tables
+            # (model axis > 1) publish nothing: the window-masked
+            # apply is not described by this model, and without the
+            # gauge the monitor correctly stays 'unknown' instead of
+            # reading false-good/bad.)
             from code2vec_tpu.training.sparse_update import (
                 sparse_step_floor_bytes, sparse_update_phase_bytes)
             ns = cfg.NUM_SAMPLED_CLASSES if cfg.USE_SAMPLED_SOFTMAX \
                 else 0
+            if self.mesh is None:
+                data_shards = 1
+            else:
+                data_shards = int(
+                    self.mesh.shape.get(DCN_AXIS, 1)
+                    * self.mesh.shape.get(DATA_AXIS, 1))
+            procs = jax.process_count()
             step_bytes = sparse_step_floor_bytes(
                 self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
-                num_sampled=ns)
+                num_sampled=ns, data_shards=max(1, data_shards),
+                processes=procs)
             upd_bytes = sparse_update_phase_bytes(
                 self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
-                num_sampled=ns)
+                num_sampled=ns, processes=procs)
             ceiling = cfg.HBM_CEILING_GBPS * 1e9
             telemetry.gauge("train/step_floor_ms",
                             step_bytes / ceiling * 1e3, emit=False,

@@ -25,15 +25,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from code2vec_tpu.parallel.compat import shard_map
 from code2vec_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, DCN_AXIS
 
 
-def _ring_attention_local(q, k, v, log_mask, axis_name: str):
+def _ring_attention_local(q, k, v, log_mask, axis_name: str,
+                          axis_size: int):
     """Per-device body (runs under shard_map): q,k,v [B, H, Cl, hd]
     local shards; log_mask [B, Cl] key-side additive mask for the LOCAL
     key shard. Returns attention output [B, H, Cl, hd] for the local
-    queries, attending over ALL keys via s ring rotations."""
-    s = jax.lax.axis_size(axis_name)
+    queries, attending over ALL keys via s ring rotations.
+    `axis_size` is static (from the mesh) — it sizes the ring table and
+    the scan, which must be trace-time constants."""
+    s = axis_size
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     ring = [(i, (i + 1) % s) for i in range(s)]
 
@@ -81,10 +85,10 @@ def ring_attention(q, k, v, log_mask, mesh, *,
     composite ('dcn','data') axes as everywhere else."""
     qkv_spec = P((DCN_AXIS, DATA_AXIS), None, axis_name, None)
     mask_spec = P((DCN_AXIS, DATA_AXIS), axis_name)
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name),
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          axis_size=int(mesh.shape[axis_name])),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-        check_vma=False)
+        out_specs=qkv_spec)
     return fn(q, k, v, log_mask)

@@ -20,8 +20,10 @@ plus the table-proportional optimizer walk this module avoids. The
 round-6 lesson (the fused requantize row-pass turned the int8 +26%
 step-time tax into ~0) repeats one level up: `--sparse_update_pallas`
 selects the fused Pallas live-row kernel on a single-device TPU and the
-XLA segment-sum reference on CPU (meshes keep the dense-carrier
-apply — see the use_carrier gate below); bench.py attributes the phase
+XLA segment-sum reference on CPU. Under a mesh (round 14) the SAME
+compact path runs inside `shard_map` via
+`sparse_update.mesh_sparse_apply` — no dense [V, E] carrier on the
+data-parallel path either; bench.py attributes the phase
 every round (`sparse_update_*`). The pre-round-6 "45 ms dense" numbers
 previously quoted here predate the adafactor default and the bf16
 tables — BENCH_r*.json is the trajectory of record.
@@ -41,7 +43,8 @@ from code2vec_tpu.ops.quant import is_quantized
 from code2vec_tpu.ops.sampled_softmax import (
     _log_expected_count, log_uniform_sample)
 from code2vec_tpu.training.sparse_adam import init_row_adam
-from code2vec_tpu.training.sparse_update import (sparse_requant_adam,
+from code2vec_tpu.training.sparse_update import (mesh_sparse_apply,
+                                                 sparse_requant_adam,
                                                  sparse_row_adam)
 
 
@@ -92,31 +95,24 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
     init_sparse_opt_state (single source of truth for the dense-param
     hyperparameters); `learning_rate`/`b1`/`b2`/`eps` govern only the
     row-sparse table updates and should match it. `sparse_update_fused`
-    selects the live-row implementation on single-device runs
-    (sparse_update facade: None = Pallas kernel on TPU, XLA reference
-    on CPU); under a mesh it is NOT consulted — the step keeps the
-    dense-carrier apply (f32 tables only; see the use_carrier gate)."""
+    selects the live-row implementation on single-device runs AND
+    under a mesh (sparse_update facade: None = Pallas kernel on TPU,
+    XLA reference on CPU — the mesh path runs it per device inside
+    shard_map's manual region, so SPARSE_UPDATE_PALLAS is honored
+    everywhere).
+
+    Mesh runs (round 14) use `mesh_sparse_apply`: the compact
+    dedup/segment-sum composition MISCOMPILES when the GSPMD
+    partitioner shards its inputs (measured, round 13 — wrong segment
+    sums), so the whole dedup + apply runs inside `shard_map` where
+    the partitioner never sees it, fed by an all-gather of the
+    per-occurrence [N]/[N, E] cotangents (NOT a [V, E] carrier).
+    Sharded INPUTS into a step built with mesh=None still hit the
+    miscompile: callers must pass the mesh they shard with."""
     dense_opt = dense_optimizer if dense_optimizer is not None else \
         optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
     S = min(num_sampled, dims.target_vocab_size)
     V = dims.target_vocab_size
-
-    # Under a mesh the tables keep the DENSE-CARRIER apply
-    # (sparse_adam.row_adam_update — the pre-round-13 form, behavior
-    # unchanged by this round): the compact path's dedup composition
-    # (jnp.unique + segment scatter into a batch-sized buffer)
-    # MISCOMPILES under GSPMD on the virtual CPU mesh (measured:
-    # wrong segment sums for sharded inputs, round 13), and the
-    # per-row DMA kernel inside a partitioned step is equally
-    # unexercised — one rule, one gate; SPARSE_UPDATE_PALLAS is NOT
-    # consulted here. Sharded INPUTS into a step built with mesh=None
-    # hit the same miscompile: callers must pass the mesh they shard
-    # with. Known caveat carried from seed: the carrier form's own
-    # mesh-vs-single-device parity test (test_sparse_adam.py) FAILS
-    # on this virtual-CPU-mesh platform at pristine HEAD too — the
-    # GSPMD table-scatter numerics issue is ROADMAP item 2's
-    # burn-down, not something this gate introduces or fixes.
-    use_carrier = mesh is not None
 
     def step_impl(params, opt_state, batch, rng):
         labels, src, pth, dst, mask, weights = batch
@@ -197,48 +193,32 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
         # (training/sparse_update.py — no dense [V, E] carrier) ----
         E = dims.embeddings_size
 
-        def apply_rows(key, ids, grads):
+        def apply_rows(key, parts):
+            """`parts` = [(ids, grads, sharded), ...] in the SAME order
+            the single-device path concatenates them — mesh_sparse_apply
+            all-gathers + concatenates in this order, which is what
+            makes mesh-vs-single-device parity bit-exact."""
             table, state = params[key], opt_state["rows"][key]
-            if use_carrier:
-                if is_quantized(table):
-                    raise ValueError(
-                        "sparse updates on int8 tables are "
-                        "single-device only (the mesh path keeps the "
-                        "dense-carrier apply, which has no {q, s} "
-                        "form)")
-                if table.dtype != jnp.float32:
-                    # the carrier form accumulates duplicate-row
-                    # cotangents in the TABLE dtype and scatter-SETs
-                    # f32 Adam output back — on bf16 that both loses
-                    # accumulation bits the compact path keeps (f32
-                    # segment sums) and hits the scatter dtype-
-                    # mismatch XLA is deprecating
-                    raise ValueError(
-                        "sparse updates under a mesh require float32 "
-                        f"tables (got {table.dtype} for {key!r}; the "
-                        "mesh path keeps the SPMD-proven dense-"
-                        "carrier apply, which is f32-only — bf16/int8 "
-                        "sparse tables are single-device)")
-                from code2vec_tpu.training.sparse_adam import \
-                    row_adam_update
-                return row_adam_update(table, state, ids.reshape(-1),
-                                       grads, count=count,
-                                       lr=learning_rate, b1=b1, b2=b2,
-                                       eps=eps)
             kw = dict(count=count, lr=learning_rate, b1=b1, b2=b2,
                       eps=eps, fused=sparse_update_fused,
                       block_rows=sparse_block_rows)
+            if mesh is not None:
+                return mesh_sparse_apply(mesh, table, state, parts,
+                                         rng=qrngs.get(key), **kw)
+            ids = jnp.concatenate([i.reshape(-1) for i, _g, _s in parts])
+            grads = jnp.concatenate(
+                [g.reshape(i.reshape(-1).shape[0], -1)
+                 for i, g, _s in parts])
             if is_quantized(table):
                 return sparse_requant_adam(table, state, ids, grads,
                                            qrngs[key], **kw)
             return sparse_row_adam(table, state, ids, grads, **kw)
 
-        tok_ids = jnp.concatenate([src.reshape(-1), dst.reshape(-1)])
-        tok_g = jnp.concatenate([g_rows["src_e"].reshape(-1, E),
-                                 g_rows["dst_e"].reshape(-1, E)])
-        new_tok, tok_state = apply_rows("token_emb", tok_ids, tok_g)
-        new_pth, pth_state = apply_rows("path_emb", pth.reshape(-1),
-                                        g_rows["pth_e"].reshape(-1, E))
+        new_tok, tok_state = apply_rows(
+            "token_emb", [(src, g_rows["src_e"].reshape(-1, E), True),
+                          (dst, g_rows["dst_e"].reshape(-1, E), True)])
+        new_pth, pth_state = apply_rows(
+            "path_emb", [(pth, g_rows["pth_e"].reshape(-1, E), True)])
 
         new_params = dict(params)
         new_params["token_emb"] = new_tok
@@ -248,11 +228,12 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
         new_rows = {"token_emb": tok_state, "path_emb": pth_state}
         if use_sampled_softmax:
             D = dims.code_vector_size
-            tgt_ids = jnp.concatenate([labels, sampled])
-            tgt_g = jnp.concatenate([g_rows["true_w"].reshape(-1, D),
-                                     g_rows["samp_w"].reshape(-1, D)])
-            new_tgt, tgt_state = apply_rows("target_emb", tgt_ids,
-                                            tgt_g)
+            # labels ride the batch axes; the shared sample is
+            # replicated on every device (same rng) — no gather needed
+            new_tgt, tgt_state = apply_rows(
+                "target_emb",
+                [(labels, g_rows["true_w"].reshape(-1, D), True),
+                 (sampled, g_rows["samp_w"].reshape(-1, D), False)])
             new_params["target_emb"] = new_tgt
             new_rows["target_emb"] = tgt_state
         else:

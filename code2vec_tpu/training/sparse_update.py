@@ -30,13 +30,12 @@ per-row DMA gather/scatter, no [V, E] materialization) on a
 single-device TPU backend, the XLA gather/scatter reference on CPU;
 `Config.SPARSE_UPDATE_PALLAS` ("auto" | "fused" | "reference") maps
 onto the `fused` argument via `resolve_sparse_update_mode`. Under a
-MESH neither path runs: sparse_steps keeps the pre-round-13
-dense-carrier apply there (the dedup composition miscompiles under
-GSPMD — see its use_carrier gate), so this module is single-device
-by construction. The reference and the kernel share the row-math
-helpers below (single source of truth), so fused-vs-reference parity
-is bit-exact on float/bf16 tables and q-exact on int8 under a shared
-salt.
+MESH (round 14) `mesh_sparse_apply` runs the SAME compact path per
+device inside `shard_map` — the GSPMD partitioner never sees the
+dedup composition it miscompiles, and the flag is honored everywhere.
+The reference and the kernel share the row-math helpers below (single
+source of truth), so fused-vs-reference parity is bit-exact on
+float/bf16 tables and q-exact on int8 under a shared salt.
 
 Consumed by training/sparse_steps.py (code2vec head: cotangents arrive
 at gathered-row granularity, no dense carrier anywhere) and
@@ -157,13 +156,19 @@ def _apply_rows_reference(table, state: RowAdamState, uids, seg, count,
 
 def _apply_quant_rows_reference(qt: QuantTable, state: RowAdamState,
                                 uids, seg, salt, count, lr, b1, b2,
-                                eps):
+                                eps, dither_ids=None):
+    # `dither_ids` (default: uids) are the rows' GLOBAL table indices
+    # for the counter-hash dither stream — they differ from the gather
+    # indices only when `qt` is a model-axis-sharded block of a larger
+    # table (mesh_sparse_apply), where the dither must still draw from
+    # the absolute [V, E] element index a full-table pass would use.
     q = jnp.take(qt["q"], uids, axis=0, mode="clip")
     s = jnp.take(qt["s"], uids, axis=0, mode="clip")
     m = jnp.take(state.m, uids, axis=0, mode="clip")
     v = jnp.take(state.v, uids, axis=0, mode="clip")
     q_new, s_new, m_new, v_new = requant_row_math(
-        q, s, m, v, seg, uids, salt, count, lr, b1, b2, eps)
+        q, s, m, v, seg, uids if dither_ids is None else dither_ids,
+        salt, count, lr, b1, b2, eps)
     new_q = qt["q"].at[uids].set(q_new, mode="drop")
     new_s = qt["s"].at[uids].set(s_new, mode="drop")
     new_m = state.m.at[uids].set(m_new, mode="drop")
@@ -189,9 +194,9 @@ def sparse_row_adam(table: jax.Array, state: RowAdamState,
     `ids` [N] (any shape, flattened) with per-occurrence cotangents
     `grads` [N, E]; only the unique rows are read or written — no dense
     [V, E] carrier. `fused=None` auto-selects the Pallas kernel on a
-    TPU backend. Single-device only: mesh steps never reach this
-    function (sparse_steps' use_carrier gate). Returns
-    (new_table, new_state)."""
+    TPU backend. Single-device entry: mesh steps route through
+    `mesh_sparse_apply`, which runs the same dedup + apply per device
+    inside shard_map. Returns (new_table, new_state)."""
     block_rows = block_rows or _BLOCK_ROWS
     uids, seg = dedup_segment_sum(ids, grads, table.shape[0],
                                   block_rows=block_rows)
@@ -229,6 +234,145 @@ def sparse_requant_adam(qt: QuantTable, state: RowAdamState,
                                          block_rows=block_rows)
     return _apply_quant_rows_reference(qt, state, uids, seg, salt,
                                        count, lr, b1, b2, eps)
+
+
+def mesh_sparse_apply(mesh, table, state: RowAdamState, parts, *,
+                      count: jax.Array, lr: float, b1: float = 0.9,
+                      b2: float = 0.999, eps: float = 1e-8, fused=None,
+                      block_rows: int | None = None, rng=None):
+    """The compact sparse update under a mesh (ROADMAP item 2): no
+    dense [V, E] carrier, bit-identical to the single-device compact
+    path.
+
+    Why not just run sparse_row_adam under GSPMD: the dedup composition
+    (jnp.unique at a static slot count + segment scatter) MISCOMPILES
+    when the partitioner shards its inputs (measured, round 13 — wrong
+    segment sums). So the whole dedup/segment-sum/apply runs INSIDE
+    `shard_map` (manual SPMD — the partitioner never sees it):
+
+      1. all-gather each sharded part's per-occurrence ids and
+         cotangents over the composite batch axes ('dcn', 'data'),
+         tiled, so every device holds the GLOBAL occurrence list in
+         batch order; replicated parts (the shared sampled-softmax
+         sample) pass through.
+      2. concatenate parts in caller order and run the SAME
+         `dedup_segment_sum` a single device would — identical input
+         order means identical f32 additions in identical order, which
+         is what makes the mesh path bit-exact vs the single-device
+         compact path (and, transitively, vs the dense-carrier
+         scatter-add in f32 — the round-13 property).
+      3. apply live rows on the LOCAL table block: with the vocab dim
+         sharded over 'model' each shard translates global unique ids
+         into its row window (out-of-window rows become the local
+         sentinel and are dropped by the scatter); data/dcn shards hold
+         identical replicas and compute the identical update. int8
+         blocks draw dither from the GLOBAL row index, so a sharded
+         pass and a full-table pass emit identical bits.
+
+    `parts` is a sequence of `(ids, grads, sharded)` triples holding
+    GLOBAL-shape arrays ([N] / [N, E]); `sharded=True` marks arrays
+    whose leading dim rides the ('dcn', 'data') batch axes (per-example
+    gathers), False marks replicated arrays (the shared sample).
+    ICI cost: one [N] + [N, E] all-gather per sharded part — the
+    per-occurrence cotangents, NOT the [V, E] table; HBM cost per
+    device: the single-device compact apply (∝ U live rows).
+    `fused` follows resolve_sparse_update_mode exactly like the
+    single-device path — SPARSE_UPDATE_PALLAS is honored under the
+    mesh (the kernel runs per device inside the manual region).
+    Returns (new_table, new_state)."""
+    from code2vec_tpu.parallel.compat import shard_map
+    from code2vec_tpu.parallel.mesh import (CONTEXT_AXIS, DATA_AXIS,
+                                            DCN_AXIS, MODEL_AXIS)
+
+    quant = is_quantized(table)
+    block_rows = block_rows or _BLOCK_ROWS
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if mesh_shape.get(CONTEXT_AXIS, 1) != 1:
+        raise ValueError(
+            "mesh sparse updates require ctx=1 (the bag encoder's "
+            f"batch never shards over 'ctx'; got mesh {mesh_shape})")
+    model_shards = mesh_shape.get(MODEL_AXIS, 1)
+    num_rows = (table["q"] if quant else table).shape[0]
+    if num_rows % model_shards:
+        raise ValueError(
+            f"table rows {num_rows} not divisible by model axis "
+            f"{model_shards} (ModelDims.vocab_pad_multiple)")
+    salt = jnp.uint32(0)
+    if quant:
+        if rng is None:
+            raise ValueError("int8 mesh sparse update needs `rng` for "
+                             "the requantize dither salt")
+        salt = jax.random.bits(rng, dtype=jnp.uint32)
+
+    ids_list = [ids.reshape(-1) for ids, _g, _sh in parts]
+    grads_list = [g.reshape(ids.shape[0], -1)
+                  for ids, (_i, g, _sh) in zip(ids_list, parts)]
+    flags = [bool(sh) for _i, _g, sh in parts]
+
+    batch_axes = (DCN_AXIS, DATA_AXIS)
+    P = jax.sharding.PartitionSpec
+    row_spec = P(MODEL_AXIS, None)
+    table_spec = {"q": row_spec, "s": row_spec} if quant else row_spec
+    in_specs = (table_spec, row_spec, row_spec, P(), P(),
+                *[P(batch_axes) if sh else P(None) for sh in flags],
+                *[P(batch_axes, None) if sh else P(None, None)
+                  for sh in flags])
+    out_specs = (table_spec, row_spec, row_spec)
+
+    def body(tbl, m, v, count_, salt_, *flat):
+        k = len(flags)
+        g_ids, g_grads = [], []
+        for i in range(k):
+            ids_i, grads_i = flat[i], flat[k + i]
+            if flags[i]:
+                ids_i = jax.lax.all_gather(ids_i, batch_axes, axis=0,
+                                           tiled=True)
+                grads_i = jax.lax.all_gather(grads_i, batch_axes,
+                                             axis=0, tiled=True)
+            g_ids.append(ids_i)
+            g_grads.append(grads_i)
+        ids = jnp.concatenate(g_ids) if k > 1 else g_ids[0]
+        grads = jnp.concatenate(g_grads) if k > 1 else g_grads[0]
+        uids, seg = dedup_segment_sum(ids, grads, num_rows,
+                                      block_rows=block_rows)
+        r_local = (tbl["q"] if quant else tbl).shape[0]
+        if model_shards > 1:
+            lo = jax.lax.axis_index(MODEL_AXIS) * r_local
+            in_win = (uids >= lo) & (uids < lo + r_local)
+            luids = jnp.where(in_win, uids - lo, r_local)
+        else:
+            luids = uids
+        st = RowAdamState(m=m, v=v)
+        if quant:
+            if model_shards > 1 or not _resolve_fused(fused):
+                # the fused kernel derives dither from its gather ids;
+                # a model-sharded block needs the GLOBAL ids for that
+                # stream, which only the reference threads through
+                new_t, new_st = _apply_quant_rows_reference(
+                    tbl, st, luids, seg, salt_, count_, lr, b1, b2,
+                    eps, dither_ids=uids)
+            else:
+                from code2vec_tpu.ops.pallas_sparse_update import \
+                    sparse_requant_adam_fused
+                new_t, new_st = sparse_requant_adam_fused(
+                    tbl, st, luids, seg, salt_, count=count_, lr=lr,
+                    b1=b1, b2=b2, eps=eps, block_rows=block_rows)
+        elif _resolve_fused(fused):
+            from code2vec_tpu.ops.pallas_sparse_update import \
+                sparse_row_adam_fused
+            new_t, new_st = sparse_row_adam_fused(
+                tbl, st, luids, seg, count=count_, lr=lr, b1=b1,
+                b2=b2, eps=eps, block_rows=block_rows)
+        else:
+            new_t, new_st = _apply_rows_reference(
+                tbl, st, luids, seg, count_, lr, b1, b2, eps)
+        return new_t, new_st.m, new_st.v
+
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
+    new_t, new_m, new_v = fn(table, state.m, state.v, count, salt,
+                             *ids_list, *grads_list)
+    return new_t, RowAdamState(m=new_m, v=new_v)
 
 
 def rows_from_dense(table, state: RowAdamState, dense_grad: jax.Array,
@@ -306,51 +450,74 @@ def table_id_counts(batch_size: int, max_contexts: int,
 def sparse_update_phase_bytes(params, batch_size: int,
                               max_contexts: int, *,
                               num_sampled: int = 0,
-                              block_rows: int = _BLOCK_ROWS) -> int:
-    """Analytic HBM bytes of the dedup/segment-sum/apply phase alone
-    for one step over the three tables — the same per-table expected-
-    unique-rows and grad-itemsize rules as sparse_step_floor_bytes
-    (single source: bench.py's `sparse_update_bytes` attribution and
-    the train loop's live `train/sparse_update_bytes` gauge must agree
-    for the same config)."""
+                              block_rows: int = _BLOCK_ROWS,
+                              processes: int = 1) -> int:
+    """Analytic PER-DEVICE HBM bytes of the dedup/segment-sum/apply
+    phase alone for one step over the three tables — the same
+    per-table expected-unique-rows and grad-itemsize rules as
+    sparse_step_floor_bytes (single source: bench.py's
+    `sparse_update_bytes` attribution and the train loop's live
+    `train/sparse_update_bytes` gauge must agree for the same config).
+    Under a mesh every device runs the phase over the all-gathered
+    GLOBAL occurrence list (mesh_sparse_apply), so `processes` scales
+    the per-process `batch_size` up to the global count; the data-axis
+    shard count does not appear (the phase is replicated, not
+    sharded). Row-sharded tables are not described — see
+    sparse_step_floor_bytes."""
     total = 0
     for key, n in table_id_counts(batch_size, max_contexts,
                                   num_sampled).items():
         table = params.get(key)
         if table is None:
             continue
+        n_global = n * processes
         if is_quantized(table):
             num_rows, grad_itemsize = table["q"].shape[0], 2
         else:
             num_rows = table.shape[0]
             grad_itemsize = table.dtype.itemsize
         total += sparse_update_traffic_bytes(
-            table, n, expected_unique_rows(n, num_rows),
+            table, n_global, expected_unique_rows(n_global, num_rows),
             grad_itemsize=grad_itemsize, block_rows=block_rows)
     return int(total)
 
 
 def sparse_step_floor_bytes(params, batch_size: int, max_contexts: int,
                             *, num_sampled: int = 0,
-                            block_rows: int = _BLOCK_ROWS) -> int:
-    """Analytic per-step HBM bytes of the FULL sparse-update step —
-    the [U, E]-aware replacement for bench.py's dense `_step_hbm_bytes`
-    (which counts a dense [V, E] carrier write+read and a
-    table-proportional optimizer walk this path does not perform):
-    forward row gathers (per occurrence), backward cotangent writes,
-    and the dedup/segment-sum/live-row apply traffic
+                            block_rows: int = _BLOCK_ROWS,
+                            data_shards: int = 1,
+                            processes: int = 1) -> int:
+    """Analytic PER-DEVICE per-step HBM bytes of the FULL sparse-update
+    step — the [U, E]-aware replacement for bench.py's dense
+    `_step_hbm_bytes` (which counts a dense [V, E] carrier write+read
+    and a table-proportional optimizer walk this path does not
+    perform): forward row gathers (per occurrence), backward cotangent
+    writes, and the dedup/segment-sum/live-row apply traffic
     (sparse_update_traffic_bytes at the uniform-ids E[U] — the bench
     worst case; real corpora are Zipfian, so this over-counts and the
     derived floor stays conservative). Dense non-table params add their
     usual grad/param/moment sweeps (negligible at java-large). Shared
     by bench.py's sparse floor attribution and the train loops' live
-    `train/step_floor_ms` gauge (the health opt_efficiency monitor)."""
+    `train/step_floor_ms` gauge (the health opt_efficiency monitor).
+
+    Mesh model (round 14): `batch_size` stays the PER-PROCESS batch
+    and `processes`/`data_shards` describe the topology — per device,
+    the forward gathers and backward cotangent writes cover only the
+    device's batch shard (global occurrences / data_shards), while the
+    dedup/segment-sum/apply phase runs over the all-gathered GLOBAL
+    occurrence list on every device (mesh_sparse_apply replicates that
+    work rather than paying a second collective round). The defaults
+    (1, 1) are the single-device identity. Row-sharded tables
+    (model axis > 1) are NOT described — callers skip the gauges
+    there (the window-masked apply needs its own model)."""
     counts = table_id_counts(batch_size, max_contexts, num_sampled)
     total = 0
     for key, n in counts.items():
         table = params.get(key)
         if table is None:
             continue
+        n_global = n * processes
+        n_local = n_global / data_shards
         if is_quantized(table):
             num_rows, emb = table["q"].shape
             row_bytes, grad_itemsize = emb * 1 + 4, 2  # q row + scale
@@ -358,11 +525,11 @@ def sparse_step_floor_bytes(params, batch_size: int, max_contexts: int,
             num_rows, emb = table.shape
             row_bytes = emb * table.dtype.itemsize
             grad_itemsize = table.dtype.itemsize
-        u = expected_unique_rows(n, num_rows)
-        total += n * row_bytes            # forward row gathers
-        total += n * emb * grad_itemsize  # backward cotangent writes
+        u = expected_unique_rows(n_global, num_rows)
+        total += int(n_local * row_bytes)  # forward row gathers
+        total += int(n_local * emb * grad_itemsize)  # bwd cotangents
         total += sparse_update_traffic_bytes(
-            table, n, u, grad_itemsize=grad_itemsize,
+            table, n_global, u, grad_itemsize=grad_itemsize,
             block_rows=block_rows)
     for key, p in params.items():
         if key in counts or is_quantized(p):

@@ -13,23 +13,32 @@ compare against a single-process oracle.
 import os
 import sys
 
+# 4 local CPU devices, pinned BEFORE the jax import: the env flag is the
+# only provisioning knob every supported JAX reads (the
+# `jax_num_cpu_devices` config key is newer-JAX-only —
+# parallel/compat.cpu_worker_env documents the seam). The parent
+# test strips XLA_FLAGS from the spawn env, so this append is authoritative.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4"
+                           ).strip()
+
 
 def main() -> None:
     pid, port, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
-    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                               num_processes=2, process_id=pid)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from code2vec_tpu.parallel.distributed import maybe_initialize
+    maybe_initialize(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=2, process_id=pid)
 
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     from code2vec_tpu.models.encoder import ModelDims, init_params
     from code2vec_tpu.parallel.distributed import fetch_global
     from code2vec_tpu.parallel.mesh import make_mesh
@@ -90,6 +99,50 @@ def main() -> None:
         jnp.sum(fetch_global(v).astype(np.float64))
         for v in restored["params"].values()))
 
+    # --- async checkpoint writer: the per-process call-order
+    # discipline exercised with REAL processes (ISSUE 9 satellite).
+    # Each process runs its OWN writer thread; orbax saves are
+    # collectives, so commit requires both writers to issue the same
+    # save sequence — two lockstep submits (the second blocks until
+    # the first commits: one-in-flight), a wait() barrier, then a
+    # crash-before-rename submit whose torn step dir must stay
+    # invisible to latest_step on BOTH processes.
+    async_dir = os.path.join(out_dir, "ckpt_async")
+    writer = ckpt.AsyncCheckpointWriter()
+    state = {"params": params, "opt_state": opt_state, "step": 2}
+    writer.submit(async_dir, state, 2, vocabs, dims)
+    state = {"params": params, "opt_state": opt_state, "step": 3}
+    writer.submit(async_dir, state, 3, vocabs, dims)
+    writer.wait()
+    async_committed = ckpt.latest_step(async_dir)
+
+    def killed_mid_save(ckpt_dir, state, step, vocabs, dims, **kw):
+        # a preemption mid-orbax-write: temp content, no renamed state
+        os.makedirs(os.path.join(ckpt_dir, f"step_{step}",
+                                 "state.orbax-checkpoint-tmp"),
+                    exist_ok=True)
+        raise RuntimeError("writer killed before commit")
+
+    crash_writer = ckpt.AsyncCheckpointWriter(save_fn=killed_mid_save)
+    crash_writer.submit(async_dir, {"params": params,
+                                    "opt_state": opt_state, "step": 4},
+                        4, vocabs, dims)
+    crash_sticky = 0
+    try:
+        crash_writer.wait()
+    except RuntimeError:
+        crash_sticky = 1
+    crash_writer.close()
+    async_latest = ckpt.latest_step(async_dir)
+    # collective restore of the last committed async step, both procs
+    restored_async = ckpt.load_checkpoint(
+        async_dir, {"params": params, "opt_state": opt_state,
+                    "step": 0})
+    async_restored_step = int(np.asarray(restored_async["step"]))
+    async_restored_checksum = float(sum(
+        jnp.sum(fetch_global(v).astype(np.float64))
+        for v in restored_async["params"].values()))
+
     checksum = float(sum(jnp.sum(fetch_global(v).astype(np.float64))
                          for v in params.values()))
 
@@ -125,6 +178,11 @@ def main() -> None:
     np.savez(os.path.join(out_dir, f"proc{pid}.npz"),
              loss=float(loss), checksum=checksum,
              restored_checksum=restored_checksum,
+             async_committed=async_committed,
+             async_latest=async_latest,
+             async_crash_sticky=crash_sticky,
+             async_restored_step=async_restored_step,
+             async_restored_checksum=async_restored_checksum,
              eval_loss=float(loss_sum), topk=np.asarray(topk_host),
              m_eval_loss=eval_res.loss,
              m_eval_top1=eval_res.topk_acc[0],

@@ -145,3 +145,59 @@ def test_repo_trajectory_is_loadable():
     rounds = load_rounds(REPO)
     assert len(rounds) >= 2
     assert all("value" in res for _r, res in rounds)
+
+
+# ---- --kind multichip: the MULTICHIP_r*.json trajectory (round 14)
+
+
+def test_multichip_ok_trajectory_passes():
+    """r01 is a seed-shaped failure record ({rc, ok, tail} — no
+    metrics): skipped, never fatal; r02-r04 gate scaling_efficiency
+    and multi_pc_per_sec with the latest inside the band."""
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "ok"),
+                   ["scaling_efficiency", "multi_pc_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 0
+    assert [r["status"] for r in rows] == ["ok", "ok"]
+    # the failure-shape round contributed no history rows
+    assert all(1 not in r["history_rounds"] for r in rows)
+
+
+def test_multichip_efficiency_regression_fails():
+    """A scaling-efficiency drop (0.87 -> 0.70) trips the gate even
+    when absolute multi-leg throughput stays inside the band — the
+    ratio is the pod-health headline."""
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "regress"),
+                   ["scaling_efficiency", "multi_pc_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 1
+    by = {r["metric"]: r for r in rows}
+    assert by["scaling_efficiency"]["status"] == "REGRESSION"
+    assert by["multi_pc_per_sec"]["status"] == "ok"
+
+
+def test_multichip_cli_kind_selects_pattern_and_metrics():
+    r = subprocess.run(
+        [sys.executable, "tools/bench_regression.py", "--kind",
+         "multichip", "--dir",
+         os.path.join(FIXTURES, "multichip", "regress"), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout + r.stderr
+    rows = json.loads(r.stdout)
+    assert {row["metric"] for row in rows} == {"scaling_efficiency",
+                                              "multi_pc_per_sec"}
+
+
+def test_multichip_repo_trajectory_accepted():
+    """The REAL repo-root MULTICHIP history must never crash the gate:
+    the seed rounds are failure records; once multichip_bench captures
+    a real round, it becomes the gated latest. Before that, rc=2 (no
+    result-carrying rounds) — either way, no exception and no false
+    REGRESSION."""
+    rc, rows = run(REPO, ["scaling_efficiency"], band=0.05, window=5,
+                   min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc in (0, 2)
+    assert all(r["status"] != "REGRESSION" for r in rows)

@@ -191,10 +191,23 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     bad_ops = Finding("host-sync-in-hot-path",
                       "code2vec_tpu/ops/pallas_sparse_update.py",
                       1, "m", "s")
+    bad_parallel = Finding("host-sync-in-hot-path",
+                           "code2vec_tpu/parallel/distributed.py",
+                           1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
-    refused = baseline_mod.write([bad, bad_training, bad_ops, ok], path)
-    assert refused == [bad, bad_training, bad_ops]
+    refused = baseline_mod.write(
+        [bad, bad_training, bad_ops, bad_parallel, ok], path)
+    assert refused == [bad, bad_training, bad_ops, bad_parallel]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
+
+
+def test_no_baseline_prefixes_cover_parallel():
+    """ISSUE 9: the distribution layer is fenced — fetch_global is a
+    sanctioned seam (rules/host_sync._SANCTIONED), not a suppression
+    or a baseline entry."""
+    assert "code2vec_tpu/parallel/" in baseline_mod.NO_BASELINE_PREFIXES
+    from tools.graftlint.rules.host_sync import _SANCTIONED
+    assert ("", "fetch_global") in _SANCTIONED
 
 
 # ---- CLI: platform-free, fast, machine-readable ----

@@ -142,10 +142,11 @@ def test_sparse_step_on_mesh_matches_single_device():
     sp = shard_params(mesh, params)
     o2 = init_sparse_opt_state(sp, optax.adam(0.01), False)
     sb = shard_batch(mesh, batch)
-    # the mesh kwarg selects the SPMD-proven dense-carrier apply (the
-    # compact dedup path miscompiles under GSPMD on the virtual CPU
-    # mesh — sparse_steps' mesh rule); this test is ALSO the
-    # cross-implementation check that carrier and compact paths agree
+    # the mesh kwarg routes the apply through mesh_sparse_apply
+    # (round 14: the compact dedup/segment-sum/live-row update inside
+    # shard_map's manual region — the GSPMD partitioner never sees the
+    # composition it miscompiles); this is the layout-invariance check
+    # that the mesh and single-device compact paths agree
     step2 = make_sparse_train_step(dims, learning_rate=0.01, mesh=mesh)
     p2, _, loss2 = step2(sp, o2, sb, rng)
 

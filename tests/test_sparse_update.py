@@ -5,11 +5,12 @@ Covers: the dedup + segment-sum + scatter-back property against the
 dense-carrier oracle (bit-for-bit in f32, including heavy-duplicate /
 all-same / all-unique extremes), interpret-mode fused-vs-reference
 parity (bit-exact on f32/bf16 tables; q-exact on int8 under the shared
-dither salt), the dispatch + config resolution, the compact path's
-exact agreement with the dense-carrier step form, a fused-path train
-smoke through make_train_step's sparse dispatch, the analytic traffic
-model, and the vm head's rows_from_dense — all on the CPU interpreter
-(tier-1).
+dither salt), the dispatch + config resolution, the mesh path's
+(mesh_sparse_apply, round 14) bit-exact agreement with BOTH the
+single-device compact apply and the dense-carrier reference on the
+8-device virtual mesh, a fused-path train smoke through
+make_train_step's sparse dispatch, the analytic traffic model, and the
+vm head's rows_from_dense — all on the CPU interpreter (tier-1).
 
 Both paths are compared UNDER JIT (the production context — the train
 step jits the whole update): eager XLA contracts multiply-adds
@@ -258,31 +259,65 @@ def _batch(seed, dims=DIMS, b=16):
         np.ones((b, C), np.float32), np.ones((b,), np.float32)))
 
 
-def test_reference_step_reproduces_carrier_step_exactly():
-    """The A/B harness contract: `--sparse_update_pallas reference`
-    (compact path) reproduces the dense-carrier step's training
-    numerics BIT-exactly over multiple constant-LR steps — mesh=object()
-    builds the carrier form of the same step (the mesh fallback), so
-    the two full jitted step graphs differ ONLY in the table apply."""
-    params = init_params(jax.random.PRNGKey(0), DIMS)
-    compact = make_sparse_train_step(DIMS, learning_rate=0.02,
-                                     sparse_update_fused=False)
-    carrier = make_sparse_train_step(DIMS, learning_rate=0.02,
-                                     mesh=object())
-    o1 = init_sparse_opt_state(params, optax.adam(0.02), False)
-    o2 = init_sparse_opt_state(params, optax.adam(0.02), False)
-    p1 = jax.tree_util.tree_map(jnp.copy, params)
-    p2 = jax.tree_util.tree_map(jnp.copy, params)
-    rng = jax.random.PRNGKey(7)
-    for i in range(5):
-        rng, k = jax.random.split(rng)
-        batch = _batch(i)
-        p1, o1, l1 = compact(p1, o1, batch, k)
-        p2, o2, l2 = carrier(p2, o2, batch, k)
-    assert float(l1) == float(l2)
-    for key in p1:
-        np.testing.assert_array_equal(np.asarray(p1[key]),
-                                      np.asarray(p2[key]), err_msg=key)
+def _mesh_for_sparse(model=2):
+    from code2vec_tpu.parallel.mesh import make_mesh
+    return make_mesh(0, model)
+
+
+def test_mesh_sparse_apply_bitexact_vs_carrier_f32():
+    """The round-14 acceptance contract: the mesh sparse-update path
+    (dedup + segment-sum + live-row apply inside shard_map on the
+    8-device virtual mesh, vocab sharded over 'model') is BIT-exact vs
+    BOTH the single-device compact path and the dense-carrier reference
+    (row_adam_update — the [V, E] scatter-add form the mesh path no
+    longer constructs). Two sharded parts + one replicated part
+    exercise the all-gather + caller-order concatenation."""
+    V, E, N = 48, 8, 64  # V % model == 0, N % (dcn*data) == 0
+    r = np.random.default_rng(11)
+    table = jnp.asarray(r.normal(size=(V, E)), jnp.float32)
+    state = init_row_adam(table)
+    ids_a = jnp.asarray(r.integers(0, V, N), jnp.int32)
+    ids_b = jnp.asarray(r.integers(0, V, N), jnp.int32)
+    ids_r = jnp.asarray(r.integers(0, V, 8), jnp.int32)  # replicated
+    g_a = jnp.asarray(r.normal(size=(N, E)), jnp.float32)
+    g_b = jnp.asarray(r.normal(size=(N, E)), jnp.float32)
+    g_r = jnp.asarray(r.normal(size=(8, E)), jnp.float32)
+    count = jnp.asarray(4, jnp.int32)
+    mesh = _mesh_for_sparse(model=2)
+
+    @jax.jit
+    def run_mesh(table, m, v, ids_a, g_a, ids_b, g_b, ids_r, g_r,
+                 count):
+        t, s = su.mesh_sparse_apply(
+            mesh, table, RowAdamState(m=m, v=v),
+            [(ids_a, g_a, True), (ids_b, g_b, True),
+             (ids_r, g_r, False)],
+            count=count, lr=0.01, fused=False, block_rows=16)
+        return t, s.m, s.v
+
+    t_mesh, m_mesh, v_mesh = run_mesh(table, state.m, state.v, ids_a,
+                                      g_a, ids_b, g_b, ids_r, g_r,
+                                      count)
+    s_mesh = RowAdamState(m=m_mesh, v=v_mesh)
+
+    ids = jnp.concatenate([ids_a, ids_b, ids_r])
+    g = jnp.concatenate([g_a, g_b, g_r])
+    # one-shot compile IS the test  # graftlint: disable=retrace-hazard
+    t_sd, s_sd = jax.jit(functools.partial(
+        su.sparse_row_adam, lr=0.01, fused=False, block_rows=16))(
+        table, state, ids, g, count=count)
+    # one-shot compile IS the test  # graftlint: disable=retrace-hazard
+    t_car, s_car = jax.jit(functools.partial(row_adam_update, lr=0.01))(
+        table, state, ids, g, count=count)
+
+    for name, (t_ref, s_ref) in {"single-device": (t_sd, s_sd),
+                                 "carrier": (t_car, s_car)}.items():
+        np.testing.assert_array_equal(np.asarray(t_mesh),
+                                      np.asarray(t_ref), err_msg=name)
+        np.testing.assert_array_equal(np.asarray(s_mesh.m),
+                                      np.asarray(s_ref.m), err_msg=name)
+        np.testing.assert_array_equal(np.asarray(s_mesh.v),
+                                      np.asarray(s_ref.v), err_msg=name)
 
 
 def test_fused_step_reproduces_reference_step_exactly():
@@ -314,21 +349,136 @@ def test_fused_step_reproduces_reference_step_exactly():
                                       np.asarray(p2[key]), err_msg=key)
 
 
-def test_mesh_carrier_path_requires_f32_tables():
-    """The mesh fallback keeps the dense-carrier apply, which is
-    f32-only: bf16 tables would accumulate duplicate cotangents in
-    bf16 (the compact path sums in f32) and scatter f32 Adam rows into
-    a bf16 table — reject at trace time, don't silently downcast."""
-    dims = ModelDims(token_vocab_size=64, path_vocab_size=32,
-                     target_vocab_size=24, embeddings_size=8,
-                     max_contexts=6, tables_dtype="bfloat16",
-                     dropout_keep_rate=1.0)
-    params = init_params(jax.random.PRNGKey(0), dims)
-    step = make_sparse_train_step(dims, learning_rate=0.02,
-                                  mesh=object())
-    opt_state = init_sparse_opt_state(params, optax.adam(0.02), False)
-    with pytest.raises(ValueError, match="float32"):
-        step(params, opt_state, _batch(0, dims), jax.random.PRNGKey(1))
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_mesh_sparse_apply_matches_single_device(dtype):
+    """f32 AND bf16 tables run the compact path under the mesh now
+    (round 14 removed the f32-only dense-carrier restriction along
+    with the carrier): bit-exact vs the single-device compact apply,
+    with the vocab dim sharded over 'model'."""
+    V, E, N = 40, 16, 32
+    r = np.random.default_rng(23)
+    table = jnp.asarray(r.normal(size=(V, E)) * 0.3).astype(dtype)
+    state = RowAdamState(
+        m=jnp.asarray(r.normal(size=(V, E)) * 0.01, jnp.float32),
+        v=jnp.asarray(np.abs(r.normal(size=(V, E))) * 1e-3,
+                      jnp.float32))
+    ids = jnp.asarray(r.integers(0, V, N), jnp.int32)
+    g = jnp.asarray(r.normal(size=(N, E)) * 0.1).astype(dtype)
+    count = jnp.asarray(3, jnp.int32)
+    mesh = _mesh_for_sparse(model=2)
+
+    @jax.jit
+    def run_mesh(table, m, v, ids, g, count):
+        t, s = su.mesh_sparse_apply(
+            mesh, table, RowAdamState(m=m, v=v), [(ids, g, True)],
+            count=count, lr=0.01, fused=False, block_rows=16)
+        return t, s.m, s.v
+
+    t_mesh, m_mesh, v_mesh = run_mesh(table, state.m, state.v, ids, g,
+                                      count)
+    # one-shot compile IS the test  # graftlint: disable=retrace-hazard
+    t_sd, s_sd = jax.jit(functools.partial(
+        su.sparse_row_adam, lr=0.01, fused=False, block_rows=16))(
+        table, state, ids, g, count=count)
+    np.testing.assert_array_equal(np.asarray(t_mesh, np.float32),
+                                  np.asarray(t_sd, np.float32))
+    np.testing.assert_array_equal(np.asarray(m_mesh),
+                                  np.asarray(s_sd.m))
+    np.testing.assert_array_equal(np.asarray(v_mesh),
+                                  np.asarray(s_sd.v))
+
+
+def test_mesh_sparse_apply_int8_q_exact():
+    """int8 {q, s} tables under the mesh: the model-sharded blocks draw
+    dither from the GLOBAL row index, so q is bit-exact vs the
+    single-device compact pass under the same rng (s within 2 ulp —
+    the pallas_requant float-contraction bound)."""
+    V, E, N = 64, 8, 32
+    r = np.random.default_rng(31)
+    qt = quantize_table(jnp.asarray(r.normal(size=(V, E)) * 0.3,
+                                    jnp.float32))
+    state = init_row_adam(qt)
+    ids = jnp.asarray(r.integers(0, V, N), jnp.int32)
+    g = jnp.asarray(r.normal(size=(N, E)) * 0.1, jnp.float32)
+    count = jnp.asarray(2, jnp.int32)
+    rng = jax.random.PRNGKey(9)
+    mesh = _mesh_for_sparse(model=2)
+
+    @jax.jit
+    def run_mesh(qt, m, v, ids, g, count, rng):
+        t, s = su.mesh_sparse_apply(
+            mesh, qt, RowAdamState(m=m, v=v), [(ids, g, True)],
+            count=count, lr=0.01, fused=False, block_rows=16, rng=rng)
+        return t, s.m, s.v
+
+    q_mesh, m_mesh, v_mesh = run_mesh(qt, state.m, state.v, ids, g,
+                                      count, rng)
+    # one-shot compile IS the test  # graftlint: disable=retrace-hazard
+    q_sd, s_sd = jax.jit(functools.partial(
+        su.sparse_requant_adam, lr=0.01, fused=False, block_rows=16))(
+        qt, state, ids, g, rng, count=count)
+    np.testing.assert_array_equal(np.asarray(q_mesh["q"]),
+                                  np.asarray(q_sd["q"]))
+    ulp = np.abs(np.asarray(q_mesh["s"]).ravel().view(np.int32)
+                 - np.asarray(q_sd["s"]).ravel().view(np.int32))
+    assert ulp.max() <= 2, ulp.max()
+    np.testing.assert_array_equal(np.asarray(m_mesh),
+                                  np.asarray(s_sd.m))
+    np.testing.assert_array_equal(np.asarray(v_mesh),
+                                  np.asarray(s_sd.v))
+
+
+def test_mesh_sparse_apply_honors_fused_flag():
+    """SPARSE_UPDATE_PALLAS is honored under the mesh: fused=True runs
+    the Pallas live-row kernel per device inside the manual region
+    (interpret mode on CPU), bit-exact vs the mesh reference."""
+    V, E, N = 32, 8, 16
+    r = np.random.default_rng(7)
+    table = jnp.asarray(r.normal(size=(V, E)), jnp.float32)
+    state = init_row_adam(table)
+    ids = jnp.asarray(r.integers(0, V, N), jnp.int32)
+    g = jnp.asarray(r.normal(size=(N, E)), jnp.float32)
+    count = jnp.asarray(1, jnp.int32)
+    mesh = _mesh_for_sparse(model=2)
+
+    def run(fused):
+        # one-shot compile IS the test  # graftlint: disable=retrace-hazard
+        @jax.jit
+        def go(table, m, v, ids, g, count):
+            t, s = su.mesh_sparse_apply(
+                mesh, table, RowAdamState(m=m, v=v), [(ids, g, True)],
+                count=count, lr=0.01, fused=fused, block_rows=16)
+            return t, s.m, s.v
+        return go(table, state.m, state.v, ids, g, count)
+
+    (t_ref, m_ref, v_ref), (t_fus, m_fus, v_fus) = run(False), run(True)
+    np.testing.assert_array_equal(np.asarray(t_ref), np.asarray(t_fus))
+    np.testing.assert_array_equal(np.asarray(m_ref), np.asarray(m_fus))
+    np.testing.assert_array_equal(np.asarray(v_ref), np.asarray(v_fus))
+
+
+def test_mesh_sparse_apply_error_paths():
+    """Trace-time guards: ctx-sharded meshes are refused (the bag
+    encoder's batch never shards over 'ctx'), int8 requires the dither
+    rng, and non-model-divisible tables are caught up front."""
+    from code2vec_tpu.parallel.mesh import make_mesh
+    table = jnp.zeros((8, 4), jnp.float32)
+    state = init_row_adam(table)
+    part = [(jnp.zeros((8,), jnp.int32),
+             jnp.zeros((8, 4), jnp.float32), True)]
+    count = jnp.asarray(1, jnp.int32)
+    with pytest.raises(ValueError, match="ctx"):
+        su.mesh_sparse_apply(make_mesh(0, 1, context=2), table, state,
+                             part, count=count, lr=0.01)
+    qt = quantize_table(jnp.ones((8, 4), jnp.float32))
+    with pytest.raises(ValueError, match="rng"):
+        su.mesh_sparse_apply(make_mesh(0, 2), qt, init_row_adam(qt),
+                             part, count=count, lr=0.01)
+    with pytest.raises(ValueError, match="divisible"):
+        su.mesh_sparse_apply(make_mesh(0, 8),
+                             jnp.zeros((12, 4), jnp.float32),
+                             init_row_adam(jnp.zeros((12, 4))),
+                             part, count=count, lr=0.01)
 
 
 def test_int8_sparse_step_trains_through_fused_path():

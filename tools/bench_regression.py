@@ -22,6 +22,12 @@ insufficient history an error instead of a pass.
 
 Accepts both file shapes: the driver wrapper (`{"parsed": {...}}`)
 and bench.py's bare result object.
+
+`--kind multichip` gates the MULTICHIP_r*.json trajectory the same
+way (tools/multichip_bench.py's scaling-efficiency rounds; the gated
+set is MULTICHIP_METRICS). Seed rounds that are driver failure
+records ({rc, ok, tail} — no metrics) are skipped like any other
+result-free file.
 """
 
 from __future__ import annotations
@@ -44,16 +50,35 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
                    "fwd_bwd_floor_pc_per_sec", "sparse_pc_per_sec")
 
-_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+# The MULTICHIP trajectory (tools/multichip_bench.py, round 14):
+# scaling efficiency is the headline — a pod that got faster per chip
+# but lost more to the process boundary is a regression this gate must
+# see; multi_pc_per_sec catches absolute multi-leg slowdowns the ratio
+# could mask (both legs regressing together).
+MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec")
+
+KINDS = {
+    "bench": ("BENCH_r*.json", DEFAULT_METRICS),
+    "multichip": ("MULTICHIP_r*.json", MULTICHIP_METRICS),
+}
+
+
+def _round_re(pattern: str) -> "re.Pattern[str]":
+    """`BENCH_r*.json` -> a regex capturing the round number."""
+    return re.compile(
+        re.escape(pattern).replace(r"\*", r"(\d+)") + "$")
 
 
 def load_rounds(dir_path: str, pattern: str = "BENCH_r*.json"
                 ) -> List[Tuple[int, Dict[str, Any]]]:
     """[(round_n, result_dict)] sorted by round. Files that carry no
-    result (a failed round's wrapper) are skipped, not fatal."""
+    result (a failed round's wrapper — e.g. the seed MULTICHIP rounds,
+    whose shape is the driver's {rc, ok, tail} failure record) are
+    skipped, not fatal."""
+    round_re = _round_re(pattern)
     rounds = []
     for path in glob.glob(os.path.join(dir_path, pattern)):
-        m = _ROUND_RE.search(os.path.basename(path))
+        m = round_re.search(os.path.basename(path))
         if not m:
             continue
         try:
@@ -65,8 +90,9 @@ def load_rounds(dir_path: str, pattern: str = "BENCH_r*.json"
             continue
         result = obj.get("parsed") if isinstance(obj, dict) else None
         if result is None and isinstance(obj, dict) \
-                and "value" in obj:
-            result = obj  # bench.py's bare stdout object
+                and ("value" in obj
+                     or obj.get("schema") == "multichip"):
+            result = obj  # bench.py / multichip_bench.py bare object
         if not isinstance(result, dict):
             print(f"warning: {path} carries no parsed bench result; "
                   "skipped", file=sys.stderr)
@@ -123,10 +149,11 @@ def check_metric(metric: str, history: List[Tuple[int, float]],
 
 
 def run(dir_path: str, metrics: List[str], band: float, window: int,
-        min_history: int, strict: bool) -> Tuple[int, List[Dict]]:
-    rounds = load_rounds(dir_path)
+        min_history: int, strict: bool,
+        pattern: str = "BENCH_r*.json") -> Tuple[int, List[Dict]]:
+    rounds = load_rounds(dir_path, pattern)
     if not rounds:
-        print(f"error: no BENCH_r*.json with results under "
+        print(f"error: no {pattern} with results under "
               f"{dir_path}", file=sys.stderr)
         return 2, []
     latest_round, latest = rounds[-1]
@@ -183,9 +210,14 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))),
         help="directory holding BENCH_r*.json (default: repo root)")
-    ap.add_argument("--metrics", nargs="+",
-                    default=list(DEFAULT_METRICS),
-                    help="result keys to gate (higher is better)")
+    ap.add_argument("--kind", choices=sorted(KINDS), default="bench",
+                    help="which round trajectory to gate: 'bench' = "
+                         "BENCH_r*.json single-chip rounds, "
+                         "'multichip' = MULTICHIP_r*.json "
+                         "scaling-efficiency rounds")
+    ap.add_argument("--metrics", nargs="+", default=None,
+                    help="result keys to gate (higher is better); "
+                         "default: the --kind's gated set")
     ap.add_argument("--band", type=float, default=0.05,
                     help="noise-band floor as a fraction (the "
                          "tolerance is max of this and the history's "
@@ -202,8 +234,11 @@ def main(argv=None) -> int:
                     help="machine-readable row dump instead of the "
                          "table")
     args = ap.parse_args(argv)
-    rc, rows = run(args.dir, args.metrics, args.band, args.window,
-                   args.min_history, args.strict)
+    pattern, kind_metrics = KINDS[args.kind]
+    metrics = args.metrics if args.metrics is not None \
+        else list(kind_metrics)
+    rc, rows = run(args.dir, metrics, args.band, args.window,
+                   args.min_history, args.strict, pattern=pattern)
     if rows:
         print(json.dumps(rows, indent=1) if args.json
               else render(rows))

@@ -34,10 +34,12 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
 
 # baselining is forbidden under these trees (ISSUE 4 acceptance;
 # training/ added with the async checkpoint writer — ISSUE 5; ops/
-# with the fused sparse-update kernel — ISSUE 8: every kernel ships
-# lint-clean, no grandfathering)
+# with the fused sparse-update kernel — ISSUE 8; parallel/ with the
+# multi-host burndown — ISSUE 9: the distribution layer ships
+# lint-clean, fetch_global is a sanctioned seam not a suppression)
 NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/",
-                        "code2vec_tpu/training/", "code2vec_tpu/ops/")
+                        "code2vec_tpu/training/", "code2vec_tpu/ops/",
+                        "code2vec_tpu/parallel/")
 
 
 def _entry(f: Finding) -> Dict[str, str]:

@@ -22,11 +22,18 @@ BFS from the hot roots, and scan every reachable function body. Roots:
 
 Sanctioned sync points (not flagged, not traversed): `device_sync` and
 `_Span.stop` — the obs helpers whose WHOLE JOB is the explicit,
-telemetry-attributed sync (`span(...).stop(sync=tree)`). Deliberate
-fetches that end a hot path (e.g. `fetch_global` bringing predict
-results to the host) belong in the baseline with their justification,
-not in this exception list: the rule should notice when a NEW sync
-joins them.
+telemetry-attributed sync (`span(...).stop(sync=tree)`) — and
+`fetch_global` (parallel/distributed.py), the ONE named terminal
+fetch that ends the predict/eval hot paths (single-process np.asarray
+or multi-process allgather; its docstring owns the policy). The
+round-11 inline suppressions inside fetch_global are gone with this
+round-14 sanction: `code2vec_tpu/parallel/` joined
+NO_BASELINE_PREFIXES, and a helper whose whole job is the deliberate
+fetch is the same species as device_sync — an explicit, greppable
+seam, not an accident this rule could catch. Accidental syncs
+(.item(), float(), bare np.asarray) stay flagged everywhere; a NEW
+deliberate fetch must either route through fetch_global or earn its
+own entry here with a policy docstring.
 
 Call resolution is heuristic by design (plain `ast`, no imports):
 simple names resolve within the module then to a globally-unique def;
@@ -56,8 +63,10 @@ _ROOT_METHODS = frozenset({
     ("PredictionServer", "_run_batch"),
 })
 
-# the obs-layer explicit sync helpers (module docstring has the policy)
-_SANCTIONED = frozenset({("", "device_sync"), ("_Span", "stop")})
+# the explicit sync/fetch seams (module docstring has the policy):
+# obs helpers + the parallel layer's one terminal result fetch
+_SANCTIONED = frozenset({("", "device_sync"), ("_Span", "stop"),
+                         ("", "fetch_global")})
 
 # attribute-call names too generic to resolve by global uniqueness
 # (container/protocol vocabulary — resolving `.get()` to some class's
