@@ -23,6 +23,16 @@ def main() -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    # Deterministic fault injection (ISSUE 10): arm the registry BEFORE
+    # anything builds — sites fetch their handles at setup time, and
+    # dist/init below is itself a site.
+    if config.FAULTS:
+        from code2vec_tpu.resilience import faults
+        try:
+            faults.install(config.FAULTS, log=config.log)
+        except ValueError as e:
+            print(f"error: --faults: {e}", file=sys.stderr)
+            return 2
     # Multi-host jobs must initialize the distributed runtime before the
     # first backend touch; single-host runs detect nothing and continue.
     maybe_initialize(config.DIST_COORDINATOR, config.DIST_NUM_PROCESSES,

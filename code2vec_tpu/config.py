@@ -348,6 +348,14 @@ class Config:
     # sweep never touches the hot path either way).
     HEALTH_EVERY_S: float = 1.0
 
+    # ---- deterministic fault injection (code2vec_tpu/resilience/,
+    # ISSUE 10): --faults <file-or-inline-json> arms the seeded
+    # failpoint registry (sites: ckpt/write, infeed/produce,
+    # train/nan_loss, train/kill, serve/extract, dist/init).
+    # Unset (default): every site is one attribute/None check, no
+    # thread, no allocation. tools/chaos.py drives the scenarios.
+    FAULTS: Optional[str] = None
+
     # ---- adversarial attacks (the noamyft fork delta, SURVEY.md §0
     # item 2; attacks/): --attack {targeted,untargeted} runs the
     # gradient-guided rename attack on --attack_input's source and
@@ -634,6 +642,12 @@ class Config:
                        dest="serve_extract_workers", type=int,
                        default=None,
                        help="persistent extractor worker pool size")
+        p.add_argument("--faults", dest="faults", default=None,
+                       help="deterministic fault injection: a JSON "
+                            "file (or inline JSON) arming named "
+                            "failpoints — see README 'Fault "
+                            "tolerance' and tools/chaos.py (unset = "
+                            "all sites disarmed, zero overhead)")
         p.add_argument("--attack", dest="attack", default=None,
                        choices=["targeted", "untargeted"],
                        help="gradient-guided variable-rename attack on "
@@ -791,6 +805,8 @@ class Config:
             cfg.SERVE_CACHE_SIZE = ns.serve_cache_size
         if ns.serve_extract_workers is not None:
             cfg.SERVE_EXTRACT_WORKERS = ns.serve_extract_workers
+        if ns.faults is not None:
+            cfg.FAULTS = ns.faults
         if ns.attack is not None:
             cfg.ATTACK = ns.attack
         if ns.attack_target is not None:

@@ -224,11 +224,15 @@ def prefetch_to_device(batches: Iterable, put_fn: Callable,
     return DevicePrefetcher(batches, put_fn, depth)
 
 
-def persistent_epochs(infeed, num_epochs: int
+def persistent_epochs(infeed, num_epochs: int, first_epoch: int = 1
                       ) -> Iterator[Tuple[int, Iterator[Tuple]]]:
     """Keep the infeed producer WARM across epoch boundaries.
 
-    Yields `(epoch, epoch_batches)` pairs, 1-based. For a threaded
+    Yields `(epoch, epoch_batches)` pairs for epochs
+    `first_epoch..num_epochs` (1-based; `first_epoch > 1` is the
+    auto-resume path — a restarted run trains only the epochs its
+    killed predecessor had not finished, with the reader's
+    `epoch_offset` replaying the matching shuffle stream). For a threaded
     infeed, ONE producer thread runs all `num_epochs` passes over the
     reader back-to-back, separating them with an epoch-end marker in
     the shared queue — so while the consumer is doing epoch-boundary
@@ -249,8 +253,9 @@ def persistent_epochs(infeed, num_epochs: int
     producer thread and its device-resident batches via the `finally`
     drain, exactly like `_ThreadedInfeed.__iter__`.
     """
+    epochs = range(first_epoch, num_epochs + 1)
     if not isinstance(infeed, _ThreadedInfeed):
-        for epoch in range(1, num_epochs + 1):
+        for epoch in epochs:
             yield epoch, iter(infeed)
         return
 
@@ -271,7 +276,7 @@ def persistent_epochs(infeed, num_epochs: int
 
     def run() -> None:
         try:
-            for _ in range(num_epochs):
+            for _ in epochs:
                 infeed._produce(put)
                 if not put((_EPOCH_END, None)):
                     return
@@ -307,7 +312,7 @@ def persistent_epochs(infeed, num_epochs: int
             yield from infeed._emit(item)
 
     try:
-        for epoch in range(1, num_epochs + 1):
+        for epoch in epochs:
             yield epoch, epoch_iter()
     finally:
         stop.set()
@@ -334,11 +339,33 @@ def build_train_infeed(reader: Iterable, *, chunk: int, depth: int,
     model can emit an `infeed/produce` span and send its context down
     a SpanChannel without changing the queue's item shape. `heartbeat`
     is the producer's obs.watchdog Heartbeat (beaten on every queue
-    put attempt). Both default to off and cost nothing when unset."""
+    put attempt). Both default to off and cost nothing when unset.
+
+    The `infeed/produce` failpoint (ISSUE 10, armed via --faults)
+    wraps the same seam: an injected raise happens ON the producer
+    thread and surfaces at the consumer through the existing
+    sentinel/exception protocol — exactly the path a real parse or
+    transfer failure takes. Only the per-batch function the CHOSEN
+    infeed actually calls is wrapped, so the site counts exactly one
+    hit per batch (the spec's `at`/`prob` semantics). Disarmed,
+    nothing is wrapped."""
+    use_chunked = chunk > 1 and mesh is None
+    from code2vec_tpu.resilience import faults
+    fp = faults.point("infeed/produce")
+    if fp.armed:
+        def _faulted(fn, _fp=fp):
+            def wrapped(b):
+                _fp.fire()
+                return fn(b)
+            return wrapped
+        if use_chunked:
+            host_arrays_fn = _faulted(host_arrays_fn)
+        else:
+            device_batch_fn = _faulted(device_batch_fn)
     if instrument is not None:
         host_arrays_fn = instrument(host_arrays_fn)
         device_batch_fn = instrument(device_batch_fn)
-    if chunk > 1 and mesh is None:
+    if use_chunked:
         infeed = ChunkedDevicePrefetcher(reader, host_arrays_fn, chunk,
                                          depth=max(1, depth))
         infeed._heartbeat = heartbeat

@@ -122,6 +122,17 @@ def _aligned_num_batches(global_examples: int, num_host_shards: int,
     return -(-largest_shard // batch_size)
 
 
+def steps_per_epoch(num_examples: int, batch_size: int,
+                    num_host_shards: int = 1) -> int:
+    """Train steps one epoch takes on every host — the public form of
+    `_aligned_num_batches` (and the same ceil-div the LR-schedule
+    horizon uses in training/optimizers.schedule_total_steps). The
+    resume path divides a restored step count by this to recover how
+    many epochs a killed run had completed."""
+    return _aligned_num_batches(num_examples, num_host_shards,
+                                batch_size)
+
+
 def _pad_batch(arrs, batch_size: int):
     """Pad along axis 0 to `batch_size` by repeating zeros/PAD rows."""
     out = []
@@ -141,7 +152,8 @@ class C2VTextReader:
     def __init__(self, path: str, vocabs: Code2VecVocabs, max_contexts: int,
                  batch_size: int, shuffle: bool = False, seed: int = 0,
                  keep_strings: bool = False,
-                 host_shard: int = 0, num_host_shards: int = 1):
+                 host_shard: int = 0, num_host_shards: int = 1,
+                 epoch_offset: int = 0):
         self.path = path
         self.vocabs = vocabs
         self.max_contexts = max_contexts
@@ -151,7 +163,11 @@ class C2VTextReader:
         self.keep_strings = keep_strings
         self.host_shard = host_shard
         self.num_host_shards = num_host_shards
-        self._epoch = 0
+        # epoch_offset: an auto-resumed run starts its shuffle stream
+        # at the epoch it was killed in, not back at epoch 0 — the
+        # permutation is seeded `seed + _epoch`, so resume replays the
+        # EXACT data order the uninterrupted run would have used
+        self._epoch = epoch_offset
         self._offsets: Optional[np.ndarray] = None
 
     def _line_offsets(self) -> np.ndarray:
@@ -229,7 +245,7 @@ class BinaryShardReader:
                  seed: int = 0, host_shard: int = 0,
                  num_host_shards: int = 1,
                  expected_max_contexts: Optional[int] = None,
-                 keep_strings: bool = False):
+                 keep_strings: bool = False, epoch_offset: int = 0):
         with open(prefix + ".bin.json", "r") as f:
             self.manifest = json.load(f)
         self.target_strings: Optional[List[str]] = None
@@ -255,7 +271,9 @@ class BinaryShardReader:
         self.seed = seed
         self.host_shard = host_shard
         self.num_host_shards = num_host_shards
-        self._epoch = 0
+        # see C2VTextReader: resume replays the interrupted epoch's
+        # seeded permutation instead of restarting the stream at 0
+        self._epoch = epoch_offset
 
     def __iter__(self) -> Iterator[BatchTensors]:
         C = self.max_contexts
@@ -326,10 +344,14 @@ def count_examples(path_or_prefix: str) -> int:
 def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
                 max_contexts: int, batch_size: int, shuffle: bool = False,
                 seed: int = 0, keep_strings: bool = False,
-                host_shard: int = 0, num_host_shards: int = 1):
+                host_shard: int = 0, num_host_shards: int = 1,
+                epoch_offset: int = 0):
     """Pick the binary fast path when a `.bin` sibling exists, else text.
     `host_shard`/`num_host_shards` (typically jax.process_index/count)
-    slice the example space so each host feeds a disjoint shard."""
+    slice the example space so each host feeds a disjoint shard.
+    `epoch_offset` starts the per-epoch shuffle stream at that epoch
+    (auto-resume: replay the killed run's data order, don't restart
+    it)."""
     prefix = path_or_prefix
     if prefix.endswith(".c2v"):
         prefix = prefix[:-len(".c2v")]
@@ -340,8 +362,10 @@ def open_reader(path_or_prefix: str, vocabs: Code2VecVocabs,
                                  seed=seed, host_shard=host_shard,
                                  num_host_shards=num_host_shards,
                                  expected_max_contexts=max_contexts,
-                                 keep_strings=keep_strings)
+                                 keep_strings=keep_strings,
+                                 epoch_offset=epoch_offset)
     return C2VTextReader(path_or_prefix, vocabs, max_contexts, batch_size,
                          shuffle=shuffle, seed=seed,
                          keep_strings=keep_strings, host_shard=host_shard,
-                         num_host_shards=num_host_shards)
+                         num_host_shards=num_host_shards,
+                         epoch_offset=epoch_offset)

@@ -77,11 +77,13 @@ class VMTextReader(C2VTextReader):
     def __init__(self, path: str, vocabs: Code2VecVocabs,
                  max_contexts: int, max_candidates: int, batch_size: int,
                  shuffle: bool = False, seed: int = 0,
-                 host_shard: int = 0, num_host_shards: int = 1):
+                 host_shard: int = 0, num_host_shards: int = 1,
+                 epoch_offset: int = 0):
         super().__init__(path, vocabs, max_contexts, batch_size,
                          shuffle=shuffle, seed=seed,
                          host_shard=host_shard,
-                         num_host_shards=num_host_shards)
+                         num_host_shards=num_host_shards,
+                         epoch_offset=epoch_offset)
         self.max_candidates = max_candidates
 
     def _parse_batch(self, batch_lines: List[str]) -> VMBatch:

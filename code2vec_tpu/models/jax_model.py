@@ -180,6 +180,7 @@ class Code2VecModel(Code2VecModelBase):
                 n = count_examples(cfg.data_path("train"))
             return n
 
+        self._n_train_examples = n_train_examples
         self.optimizer = build_optimizer(
             cfg, n_train_examples,
             manifest if cfg.is_loading else None)
@@ -321,11 +322,18 @@ class Code2VecModel(Code2VecModelBase):
     # ---- train (SURVEY.md §4.2) ----
     def train(self) -> None:
         cfg = self.config
+        # auto-resume (ISSUE 10): the ONE shared epoch-offset
+        # arithmetic (models/setup.py — the recovery contract both
+        # heads must agree on)
+        from code2vec_tpu.models.setup import resume_epoch_offset
+        completed_epochs = resume_epoch_offset(
+            cfg, self.step_num, self._n_train_examples, self.log)
         reader = open_reader(
             cfg.data_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
             cfg.TRAIN_BATCH_SIZE, shuffle=True, seed=cfg.SEED,
             host_shard=jax.process_index(),
-            num_host_shards=jax.process_count())
+            num_host_shards=jax.process_count(),
+            epoch_offset=completed_epochs)
         self.log(f"starting training: dims={self.dims}, "
                  f"devices={len(jax.devices())}, mesh={self.mesh}")
         window_examples = 0
@@ -465,14 +473,31 @@ class Code2VecModel(Code2VecModelBase):
             reader,
             instrument=infeed_produce_instrument(tracer, infeed_channel),
             heartbeat=infeed_hb if watchdog.enabled else None)
+        # chaos failpoints (--faults, ISSUE 10): disarmed — the default
+        # — each is one attribute read per step (the obs discipline)
+        from code2vec_tpu.resilience import faults, retry
+        if telemetry.enabled:
+            retry.set_telemetry(telemetry)
+        nan_fp, kill_fp = faults.train_step_points()
         try:
             for epoch, epoch_batches in persistent_epochs(
-                    infeed, cfg.NUM_TRAIN_EPOCHS):
+                    infeed, cfg.NUM_TRAIN_EPOCHS,
+                    first_epoch=completed_epochs + 1):
                 for dev_batch, batch in recorder.wrap(epoch_batches):
                     profiler.tick(steps_into_training, self.params)
-                    self.rng, step_rng = jax.random.split(self.rng)
+                    # step rng keyed on the ABSOLUTE step (not a
+                    # sequentially split stream): a run killed at step
+                    # k and auto-resumed draws the same dropout /
+                    # sampling keys the uninterrupted run would —
+                    # recovery replays the trajectory bit-for-bit
+                    step_rng = jax.random.fold_in(self.rng,
+                                                  self.step_num)
                     self.params, self.opt_state, loss = self._train_step(
                         self.params, self.opt_state, dev_batch, step_rng)
+                    if nan_fp.armed and nan_fp.hit():
+                        loss = loss * float("nan")  # poison the loss
+                    if kill_fp.armed:
+                        kill_fp.fire(step=self.step_num + 1)
                     self.step_num += 1
                     steps_into_training += 1
                     window_examples += batch.num_valid_examples

@@ -37,8 +37,14 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
     - schedule comes from cfg (already manifest-overridden when
       loading — the opt_state structure is fixed at first training);
     - a non-constant schedule needs a decay horizon: this run's step
-      count (from `count_examples_fn`, only called when training)
-      extended past the restored step on resume;
+      count (from `count_examples_fn`, only called when training).
+      A plain --load fine-tune extends the horizon past the restored
+      step (it trains a FULL epoch budget more); an --auto_resume run
+      does NOT — it resumes ITSELF (round 15: the restored step
+      counts toward NUM_TRAIN_EPOCHS), so its horizon is the original
+      run's epochs x steps-per-epoch and the resumed LR curve matches
+      the uninterrupted run's at every absolute step (the chaos-parity
+      contract, schedule-agnostic);
     - eval/predict-only runs take no optimizer steps, so horizon 1
       yields the right opt_state STRUCTURE.
     """
@@ -49,12 +55,13 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
     total_steps = 0
     if schedule != "constant":
         if cfg.is_training:
+            restored = (int(manifest.get("step", 0))
+                        if cfg.is_loading and manifest else 0)
             total_steps = schedule_total_steps(
                 count_examples_fn(), cfg.TRAIN_BATCH_SIZE,
                 cfg.NUM_TRAIN_EPOCHS,
                 num_hosts=jax.process_count(),
-                restored_step=(int(manifest.get("step", 0))
-                               if cfg.is_loading and manifest else 0))
+                restored_step=0 if cfg.AUTO_RESUME else restored)
             if schedule == "warmup_cosine":
                 # resolve auto-warmup (0) to its effective length NOW so
                 # the manifest records it and a resume follows the SAME
@@ -68,3 +75,30 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
                 warmup_steps=cfg.LR_WARMUP_STEPS),
         cfg.EMBEDDING_OPTIMIZER, trust_ratio=cfg.TRUST_RATIO,
         trust_ratio_scope=cfg.TRUST_RATIO_SCOPE)
+
+
+def resume_epoch_offset(cfg: Config, step_num: int,
+                        count_examples_fn: Callable[[], int],
+                        log: Callable[[str], None]) -> int:
+    """Completed epochs to skip on --auto_resume (ISSUE 10): the
+    restored step count over the per-host steps-per-epoch (the same
+    ceil-div the reader's aligned batch count and the LR horizon use —
+    exact because saves only happen at epoch boundaries). A resumed
+    run then trains ONLY the remaining epochs, with the reader's
+    shuffle stream advanced to match; together with the step-keyed
+    rng in the train loops, recovery replays the uninterrupted
+    trajectory exactly (the chaos-parity acceptance). Plain --load +
+    --data keeps fine-tune semantics (a full NUM_TRAIN_EPOCHS more).
+    ONE definition for both model heads: this arithmetic is the
+    recovery contract, and hand-synced copies would drift."""
+    if not (cfg.AUTO_RESUME and step_num > 0):
+        return 0
+    from code2vec_tpu.data.reader import steps_per_epoch
+    spe = steps_per_epoch(count_examples_fn(), cfg.TRAIN_BATCH_SIZE,
+                          jax.process_count())
+    completed = min(cfg.NUM_TRAIN_EPOCHS, step_num // spe)
+    if completed:
+        log(f"auto-resume: restored step {step_num} = {completed} "
+            f"completed epoch(s) x {spe} steps; training epochs "
+            f"{completed + 1}..{cfg.NUM_TRAIN_EPOCHS}")
+    return completed

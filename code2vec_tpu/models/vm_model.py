@@ -98,6 +98,7 @@ class VarMisuseModel:
             from code2vec_tpu.data.reader import count_examples
             return count_examples(self._vm_path("train"))
 
+        self._n_train_examples = n_train_examples
         self.optimizer = build_optimizer(
             cfg, n_train_examples,
             manifest if cfg.is_loading else None)
@@ -172,11 +173,17 @@ class VarMisuseModel:
 
     def train(self) -> None:
         cfg = self.config
+        # auto-resume epoch offset: the ONE shared arithmetic (see
+        # models/setup.resume_epoch_offset — the recovery contract)
+        from code2vec_tpu.models.setup import resume_epoch_offset
+        completed_epochs = resume_epoch_offset(
+            cfg, self.step_num, self._n_train_examples, self.log)
         reader = VMTextReader(
             self._vm_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
             cfg.MAX_CANDIDATES, cfg.TRAIN_BATCH_SIZE, shuffle=True,
             seed=cfg.SEED, host_shard=jax.process_index(),
-            num_host_shards=jax.process_count())
+            num_host_shards=jax.process_count(),
+            epoch_offset=completed_epochs)
         self.log(f"varmisuse training: dims={self.dims}, "
                  f"max_candidates={cfg.MAX_CANDIDATES}")
         window, t0 = 0, time.time()
@@ -249,18 +256,31 @@ class VarMisuseModel:
             device_batch_fn=self._device_batch, log=self.log,
             instrument=infeed_produce_instrument(tracer, infeed_channel),
             heartbeat=infeed_hb if watchdog.enabled else None)
+        # chaos failpoints (--faults, ISSUE 10) — disarmed, each is one
+        # attribute read per step (same wiring as jax_model)
+        from code2vec_tpu.resilience import faults, retry
+        if telemetry.enabled:
+            retry.set_telemetry(telemetry)
+        nan_fp, kill_fp = faults.train_step_points()
         # one warm producer thread across epoch boundaries (same as
         # jax_model): epoch k+1 parses/transfers during the boundary
         # save + eval instead of cold-restarting the double buffer
         try:
             for epoch, epoch_batches in persistent_epochs(
-                    infeed, cfg.NUM_TRAIN_EPOCHS):
+                    infeed, cfg.NUM_TRAIN_EPOCHS,
+                    first_epoch=completed_epochs + 1):
                 for dev_batch, batch in recorder.wrap(epoch_batches):
                     profiler.tick(steps_into_training, self.params)
                     steps_into_training += 1
-                    self.rng, k = jax.random.split(self.rng)
+                    # absolute-step-keyed rng: auto-resume replays the
+                    # uninterrupted run's key stream (see jax_model)
+                    k = jax.random.fold_in(self.rng, self.step_num)
                     self.params, self.opt_state, loss = self._train_step(
                         self.params, self.opt_state, dev_batch, k)
+                    if nan_fp.armed and nan_fp.hit():
+                        loss = loss * float("nan")  # poison the loss
+                    if kill_fp.armed:
+                        kill_fp.fire(step=self.step_num + 1)
                     self.step_num += 1
                     window += batch.num_valid_examples
                     loss_f = (recorder.end_step(self.step_num, loss,

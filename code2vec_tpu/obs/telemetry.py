@@ -182,8 +182,10 @@ def _build_run_manifest(config, mesh, component: str) -> Dict[str, Any]:
         devs = jax.devices()
         devices = {"platform": devs[0].platform, "count": len(devs),
                    "local_count": len(jax.local_devices())}
-    except Exception:
-        pass
+    except Exception as e:
+        # record WHY topology is absent instead of swallowing it — a
+        # manifest without device info should say so
+        devices = {"unavailable": str(e)[:200]}
     _RUN_SEQ[0] += 1
     run_id = (f"run-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
               f"-p{process_index}-{_RUN_SEQ[0]}")

@@ -108,7 +108,43 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
         # hang (set CODE2VEC_DIST_DISABLE=1 to skip auto-detection).
         log(f"initializing jax.distributed (explicit={explicit}) — "
             "blocks until all peers connect")
-    distributed_initialize(**kwargs)
+    # Transient coordination-service/Gloo connect failures ride the
+    # shared retry policy (ISSUE 10) instead of killing the worker on
+    # the first hiccup. jax's State.initialize assigns the
+    # global-state client BEFORE connect(), so a failed connect leaves
+    # it set and a naive re-call raises "should only be called once"
+    # forever, masking the real error — each failed attempt therefore
+    # best-effort RESETS the distributed global state
+    # (jax.distributed.shutdown clears client/service) so the retry
+    # retries the connect, not the precondition. Genuine
+    # non-transients give up immediately: the ordering precondition
+    # ("must run before any JAX computation") and a reset that didn't
+    # take ("should only be called once" — surfacing it beats burning
+    # the budget on it). The `dist/init` failpoint exercises this.
+    from code2vec_tpu.resilience import faults
+    from code2vec_tpu.resilience import retry as retry_mod
+
+    def _init() -> None:
+        faults.fire("dist/init")
+        try:
+            distributed_initialize(**kwargs)
+        except BaseException:
+            import jax.distributed
+            try:
+                jax.distributed.shutdown()
+            except Exception as reset_err:
+                # keep the ORIGINAL connect error in flight; a failed
+                # reset only means the next attempt gives up fast
+                if log is not None:
+                    log("distributed-state reset after failed init "
+                        f"also failed: {reset_err}")
+            raise
+
+    retry_mod.transient_distributed(
+        "distributed-init", log=log,
+        giveup=lambda e: (
+            "must run before any JAX computation" in str(e)
+            or "should only be called once" in str(e))).call(_init)
     _initialized = True
     if log is not None:
         log(f"jax.distributed initialized: process "

@@ -1,0 +1,25 @@
+"""code2vec_tpu.resilience — deterministic fault injection and unified
+retry/backoff (ISSUE 10).
+
+Two modules, one discipline:
+
+  - `faults`: a seeded failpoint registry with named injection sites
+    wired through the real seams (checkpoint write, infeed producer,
+    train step, serving extractor, distributed init). Disabled — the
+    default — every site costs one attribute/None check; nothing is
+    allocated, no thread starts (the obs/ pattern). Armed via
+    `--faults <json>` and driven by tools/chaos.py.
+  - `retry`: ONE jittered-exponential-backoff policy with per-call
+    attempt budgets and `resilience/retry` telemetry, replacing the
+    hand-rolled retries that had accreted in tools/multichip_bench.py
+    and the two_process_results fixture, and adopted by distributed
+    init, the supervisor's cohort relaunch, extractor-pool restart and
+    transient checkpoint-IO errors.
+
+Stdlib-only at import time (jax is lazy and touched only on armed
+paths); `tools/graftlint` fences this tree under NO_BASELINE_PREFIXES.
+"""
+
+from code2vec_tpu.resilience.faults import (FaultInjected,  # noqa: F401
+                                            FaultPoint)
+from code2vec_tpu.resilience.retry import RetryPolicy  # noqa: F401

@@ -13,6 +13,18 @@ threads sharing one `Extractor`, validated up front (`preflight()`), so
 a missing or non-executable binary fails at server start with the
 build_extractor.sh hint instead of as an opaque subprocess error on the
 first request.
+
+Crash recovery (ISSUE 10 satellite): a WORKER-LEVEL failure — an
+exec-layer death (`ExtractorCrash`), or the `serve/extract` failpoint —
+restarts the pool IN PLACE on a background thread (fresh `Extractor`,
+fresh preflight, fresh executor) instead of poisoning every subsequent
+request. While the restart is in flight, submissions shed with the
+server's explicit `ServerOverloaded` (bounded failure, not a hang);
+per-INPUT failures (bad source, no methods, timeout) stay plain
+`ExtractorError` and never trigger a restart. Restart attempts ride
+the shared `resilience/retry` policy; if they exhaust (the binary is
+really gone), the pool goes dead and every submit re-raises the
+preflight error with the build hint.
 """
 
 from __future__ import annotations
@@ -21,9 +33,12 @@ import concurrent.futures
 import os
 import shutil
 import subprocess
-from typing import List, Tuple
+import threading
+from typing import List, Optional, Tuple
 
 from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.resilience import retry as retry_mod
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -35,6 +50,12 @@ _BUILD_HINT = ("build it with ./build_extractor.sh "
 
 class ExtractorError(RuntimeError):
     pass
+
+
+class ExtractorCrash(ExtractorError):
+    """A worker-level death (exec failure, injected crash) rather than
+    a per-input failure: the pool restarts in place on seeing one.
+    Subclasses ExtractorError so existing callers' contracts hold."""
 
 
 class Extractor:
@@ -90,6 +111,9 @@ class Extractor:
     def extract_paths(self, path: str) -> Tuple[List[str], List[str]]:
         """Returns (method_names, raw_context_lines) for one source file;
         line format: `name tok,pathHash,tok ...` (SURVEY.md §3.2)."""
+        # chaos failpoint (--faults): an injected worker death the pool
+        # must survive by restarting in place; disarmed = one None check
+        faults.fire("serve/extract", path=path)
         if self.language == "python":
             # Python parsing is native to the host (SURVEY.md §8.3 step 8)
             try:
@@ -123,8 +147,9 @@ class Extractor:
                     f"extractor timed out on {path}") from e
             except OSError as e:
                 # exec failure (wrong arch, truncated binary, perms
-                # dropped after the preflight) — keep the hint attached
-                raise ExtractorError(
+                # dropped after the preflight) — a WORKER death, not a
+                # per-input failure: the pool restarts on it
+                raise ExtractorCrash(
                     f"cannot run extractor {cmd[0]}: {e}; "
                     f"re-{_BUILD_HINT}") from e
             if proc.returncode != 0:
@@ -141,21 +166,113 @@ class ExtractorPool:
     """Persistent extraction workers for the prediction server: N
     threads over ONE `Extractor` (stateless per call), preflighted at
     construction. Extraction requests stop paying a pool/interpreter
-    spawn per request; with libc2v built they are fully in-process."""
+    spawn per request; with libc2v built they are fully in-process.
+
+    A worker CRASH (`ExtractorCrash` / an injected `serve/extract`
+    fault) restarts the pool in place: the crashing request re-raises,
+    requests racing the restart shed with `ServerOverloaded`, and the
+    next request after the rebuild succeeds — one bad exec never
+    poisons the server's remaining lifetime."""
 
     def __init__(self, config: Config, workers: int = None,
-                 **extractor_kwargs):
+                 telemetry=None, **extractor_kwargs):
+        self._config = config
+        self._extractor_kwargs = dict(extractor_kwargs)
+        self._telemetry = telemetry
         self.extractor = Extractor(config, **extractor_kwargs)
         self.extractor.preflight()
-        n = workers if workers is not None \
+        self._workers = workers if workers is not None \
             else max(1, config.SERVE_EXTRACT_WORKERS)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=n, thread_name_prefix="extract")
+        self._lock = threading.Lock()
+        self._pool = self._new_executor()
+        self._generation = 0
+        self._restarting = False
+        self._closed = False
+        self._dead: Optional[BaseException] = None
+
+    def _new_executor(self) -> "concurrent.futures.ThreadPoolExecutor":
+        return concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._workers, thread_name_prefix="extract")
+
+    def _count(self, name: str) -> None:
+        if self._telemetry is not None:
+            self._telemetry.count(name)
 
     def submit(self, path: str) -> "concurrent.futures.Future":
         """Async extraction; the future resolves to
-        (method_names, raw_context_lines) or raises `ExtractorError`."""
-        return self._pool.submit(self.extractor.extract_paths, path)
+        (method_names, raw_context_lines) or raises `ExtractorError`.
+        Sheds with `ServerOverloaded` while a crash restart is in
+        flight; re-raises the terminal preflight error once restart
+        attempts are exhausted."""
+        from code2vec_tpu.serving.batcher import ServerOverloaded
+        with self._lock:
+            if self._dead is not None:
+                raise self._dead
+            if self._restarting:
+                self._count("serve/shed")
+                raise ServerOverloaded(
+                    "extractor pool restarting after a worker crash")
+            # submit UNDER the lock: _begin_restart flips _restarting
+            # and shuts the old executor down under/after this same
+            # lock, so a request that passed the check above must reach
+            # the executor before the shutdown — submitting outside
+            # would race it into RuntimeError('cannot schedule new
+            # futures after shutdown') instead of the documented shed
+            return self._pool.submit(self._run_extract,
+                                     self._generation, path)
+
+    def _run_extract(self, generation: int, path: str):
+        try:
+            return self.extractor.extract_paths(path)
+        except (ExtractorCrash, faults.FaultInjected):
+            self._begin_restart(generation)
+            raise
+
+    def _begin_restart(self, generation: int) -> None:
+        with self._lock:
+            if (self._closed or self._restarting
+                    or self._generation != generation):
+                return  # a newer pool already exists / is being built
+            self._restarting = True
+            old = self._pool
+        self._count("serve/extractor_restart")
+        old.shutdown(wait=False)
+        threading.Thread(target=self._restart, daemon=True,
+                         name="extract-restart").start()
+
+    def _restart(self) -> None:
+        """Background rebuild: fresh Extractor + preflight + executor,
+        under the shared retry policy (a crash during a deploy's binary
+        swap resolves itself; a permanently-gone binary exhausts the
+        budget and the pool goes dead with the build hint attached)."""
+        policy = retry_mod.RetryPolicy(
+            "extractor-restart", max_attempts=3, base_delay_s=0.05,
+            max_delay_s=1.0, retry_on=(ExtractorError, OSError))
+
+        def build() -> Extractor:
+            ex = Extractor(self._config, **self._extractor_kwargs)
+            ex.preflight()
+            return ex
+
+        try:
+            fresh = policy.call(build)
+        except BaseException as e:
+            with self._lock:
+                self._dead = e
+                self._restarting = False
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self.extractor = fresh
+            self._pool = self._new_executor()
+            self._generation += 1
+            self._restarting = False
+
+    @property
+    def restarting(self) -> bool:
+        with self._lock:
+            return self._restarting
 
     def extract_paths(self, path: str) -> Tuple[List[str], List[str]]:
         """Synchronous extraction through the pool (keeps concurrent
@@ -163,4 +280,7 @@ class ExtractorPool:
         return self.submit(path).result()
 
     def close(self) -> None:
-        self._pool.shutdown(wait=False)
+        with self._lock:
+            self._closed = True
+            pool = self._pool
+        pool.shutdown(wait=False)

@@ -211,6 +211,7 @@ class PredictionServer:
         with self._lifecycle_lock:
             if self._extractors is None:
                 self._extractors = ExtractorPool(self.config,
+                                                 telemetry=self.telemetry,
                                                  **extractor_kwargs)
                 self._extractor_kwargs = dict(extractor_kwargs)
             elif extractor_kwargs != self._extractor_kwargs:

@@ -33,25 +33,61 @@ Sidecars are write-once per checkpoint dir: vocabularies never change
 within a run, and the manifest only carries structure (its `step` field
 is advisory — `--release` derives the true step from the committed step
 dirs), so epoch saves skip the re-pickle/rewrite when nothing changed.
+
+Integrity (ISSUE 10): every committed step dir carries a
+`checksums.json` per-file sha256 manifest of its `state` tree, written
+by process 0 AFTER the commit rename. Restore verifies the files
+against it first (`verify_step`); a mismatch — a bit-flipped leaf blob,
+a truncated write the rename protocol could not see — quarantines the
+step dir under `<ckpt_dir>/quarantine/` and falls back to the previous
+committed step instead of feeding corrupt bytes into orbax. Hashing is
+file-level rather than pytree-leaf-level on purpose: it is
+resharding-proof (a checkpoint written on one mesh reloads onto
+another — per-shard leaf digests would not survive that) and catches
+exactly the storage-rot failure mode quarantine exists for. A committed
+step WITHOUT a checksums file (pre-integrity checkpoints, or a death in
+the rename->checksums window) restores as before, unverified.
+
+Transient checkpoint-IO errors retry through the shared
+`resilience/retry` policy (single-process only — a multi-host orbax
+save is a collective, and one process re-issuing it alone would
+deadlock the cohort); ENOSPC is a giveup, surfacing at the commit
+barrier immediately, because a full disk does not refill on a backoff
+schedule. `faults.fire("ckpt/write")` sits inside the retried write so
+chaos scenarios exercise both the retry and the sticky-error path.
 """
 
 from __future__ import annotations
 
+import errno
+import hashlib
 import json
 import os
 import re
 import shutil
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.resilience import retry as retry_mod
 from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+CHECKSUMS_NAME = "checksums.json"
+QUARANTINE_DIRNAME = "quarantine"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A committed step dir failed checksum verification and no
+    quarantine fallback was possible (explicit-step restore, or a
+    multi-process load where a unilateral quarantine move would race
+    the cohort — the supervisor quarantines before relaunch there)."""
 
 
 def _step_dirs(ckpt_dir: str):
@@ -134,14 +170,48 @@ def _write_sidecars(ckpt_dir: str, vocabs: Code2VecVocabs,
         json.dump(manifest, f, indent=1)
 
 
+# lazily built so importing this module costs nothing extra; one shared
+# policy, per-call budgets (retry.py's contract)
+_CKPT_IO_RETRY: Optional[retry_mod.RetryPolicy] = None
+
+
+def _ckpt_io_retry() -> retry_mod.RetryPolicy:
+    global _CKPT_IO_RETRY
+    if _CKPT_IO_RETRY is None:
+        _CKPT_IO_RETRY = retry_mod.RetryPolicy(
+            "checkpoint-io", max_attempts=3, base_delay_s=0.05,
+            max_delay_s=1.0, retry_on=(OSError,),
+            # a full disk is not transient: surface it at the commit
+            # barrier NOW instead of burning the backoff budget
+            giveup=lambda e: getattr(e, "errno", None) == errno.ENOSPC)
+    return _CKPT_IO_RETRY
+
+
 def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
                     vocabs: Code2VecVocabs, dims: ModelDims,
                     extra_manifest: Optional[Dict[str, Any]] = None,
                     max_to_keep: int = 10) -> str:
     os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, f"step_{step}", "state")
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), state, force=True)
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    path = os.path.join(step_dir, "state")
+
+    def _write() -> None:
+        # failpoint INSIDE the retried callable: slow disk (sleep),
+        # ENOSPC (io_error — a giveup, lands at the commit barrier),
+        # transient EIO (retried here), crash-before-rename (kill)
+        faults.fire("ckpt/write", path=step_dir, step=step)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(path), state, force=True)
+
+    if jax.process_count() == 1:
+        _ckpt_io_retry().call(_write)
+    else:
+        # multi-host orbax saves are collectives: one process retrying
+        # alone would deadlock its peers — the supervisor's cohort
+        # relaunch is the multi-process retry
+        _write()
+    if jax.process_index() == 0:
+        write_step_checksums(ckpt_dir, step)
     _write_sidecars(ckpt_dir, vocabs,
                     _build_manifest(step, dims, extra_manifest))
     # Retention: keep the newest `max_to_keep` step dirs (reference
@@ -150,6 +220,114 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
     for _s, d in steps[:-max_to_keep]:
         shutil.rmtree(d, ignore_errors=True)
     return path
+
+
+# ---- integrity: per-file checksums, verify-on-restore, quarantine ----
+
+def _hash_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _state_file_digests(step_dir: str) -> Dict[str, Dict[str, Any]]:
+    """{relpath-under-step_dir: {sha256, bytes}} for every file of the
+    committed `state` tree, sorted for a stable manifest."""
+    state_dir = os.path.join(step_dir, "state")
+    out: Dict[str, Dict[str, Any]] = {}
+    for base, _dirs, files in os.walk(state_dir):
+        for name in sorted(files):
+            p = os.path.join(base, name)
+            rel = os.path.relpath(p, step_dir).replace(os.sep, "/")
+            out[rel] = {"sha256": _hash_file(p),
+                        "bytes": os.path.getsize(p)}
+    return dict(sorted(out.items()))
+
+
+def write_step_checksums(ckpt_dir: str, step: int) -> str:
+    """Write `step_<N>/checksums.json` over the committed state tree.
+    Runs AFTER the commit rename: a death in the rename->checksums
+    window leaves a committed-but-unverified step, which restores like
+    a pre-integrity checkpoint (verify_step returns None)."""
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    payload = {"step": step, "files": _state_file_digests(step_dir)}
+    dest = os.path.join(step_dir, CHECKSUMS_NAME)
+    tmp = dest + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, dest)
+    return dest
+
+
+def verify_step(ckpt_dir: str, step: int) -> Optional[bool]:
+    """True = every state file matches its recorded digest; False = any
+    mismatch/missing/extra file (corrupt); None = no checksums manifest
+    (pre-integrity checkpoint — nothing to verify against)."""
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    manifest_path = os.path.join(step_dir, CHECKSUMS_NAME)
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            recorded = json.load(f)["files"]
+    except (OSError, ValueError, KeyError):
+        return False  # an unreadable integrity manifest IS corruption
+    actual = _state_file_digests(step_dir)
+    if set(actual) != set(recorded):
+        return False
+    return all(actual[k]["sha256"] == v.get("sha256")
+               for k, v in recorded.items())
+
+
+def quarantine_step(ckpt_dir: str, step: int,
+                    log: Optional[Callable[[str], None]] = None) -> str:
+    """Move a corrupt step dir under `<ckpt_dir>/quarantine/` (kept for
+    the postmortem, invisible to `latest_step`/retention). Returns the
+    destination path."""
+    qdir = os.path.join(ckpt_dir, QUARANTINE_DIRNAME)
+    os.makedirs(qdir, exist_ok=True)
+    src = os.path.join(ckpt_dir, f"step_{step}")
+    dest = os.path.join(qdir, f"step_{step}")
+    n = 0
+    while os.path.exists(dest):  # a re-corrupted rewrite of the same step
+        n += 1
+        dest = os.path.join(qdir, f"step_{step}.{n}")
+    os.replace(src, dest)
+    if log is not None:
+        log(f"checkpoint step {step} failed verification -> "
+            f"quarantined at {dest}")
+    return dest
+
+
+def verify_and_resolve(ckpt_dir: str, *, quarantine: bool = True,
+                       log: Optional[Callable[[str], None]] = None
+                       ) -> Tuple[Optional[int], List[str]]:
+    """Walk committed steps newest-first, verifying each; corrupt ones
+    are quarantined (when allowed). Returns (first verified-or-
+    unverifiable step usable for resume — None when none survive,
+    quarantined dir paths). The supervisor runs this before every
+    (re)launch so a child only ever resumes from a VERIFIED committed
+    step."""
+    quarantined: List[str] = []
+    for step, _d in reversed(_step_dirs(ckpt_dir)):
+        ok = verify_step(ckpt_dir, step)
+        if ok is False:
+            if not quarantine:
+                raise CheckpointCorrupt(
+                    f"checkpoint step {step} under {ckpt_dir} failed "
+                    f"checksum verification")
+            quarantined.append(quarantine_step(ckpt_dir, step, log))
+            continue
+        if ok is None and log is not None:
+            log(f"checkpoint step {step}: no {CHECKSUMS_NAME} "
+                "(pre-integrity checkpoint) — restoring unverified")
+        return step, quarantined
+    return None, quarantined
 
 
 def snapshot_state(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -363,13 +541,39 @@ def load_dims(ckpt_dir: str) -> ModelDims:
 
 
 def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
-                    step: Optional[int] = None) -> Dict[str, Any]:
+                    step: Optional[int] = None, *,
+                    verify: bool = True,
+                    log: Optional[Callable[[str], None]] = None
+                    ) -> Dict[str, Any]:
     """Restore the pytree at `step` (default: latest) with the dtype /
-    sharding layout of `template` (abstract arrays are fine)."""
-    if step is None:
-        step = latest_step(ckpt_dir)
-    if step is None:
-        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    sharding layout of `template` (abstract arrays are fine).
+
+    Verify-on-restore (default on): the step's files are checked
+    against its `checksums.json` first. An EXPLICITLY requested corrupt
+    step raises `CheckpointCorrupt` — the caller asked for those bytes,
+    silently substituting others would be worse. A corrupt LATEST step
+    is quarantined (single-process only: a multi-process unilateral
+    move would race the cohort, so those raise and let the supervisor
+    quarantine before relaunch) and the restore falls back to the
+    previous committed step. Steps without a checksums manifest restore
+    unverified, as before."""
+    explicit = step is not None
+    while True:
+        if step is None:
+            step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+        if not verify or verify_step(ckpt_dir, step) is not False:
+            break
+        if explicit or jax.process_count() > 1:
+            raise CheckpointCorrupt(
+                f"checkpoint step {step} under {ckpt_dir} failed "
+                f"checksum verification"
+                + ("" if explicit else
+                   " (multi-process load: quarantine via the "
+                   "supervisor, not unilaterally)"))
+        quarantine_step(ckpt_dir, step, log)
+        step = None  # fall back to the previous committed step
     path = os.path.join(ckpt_dir, f"step_{step}", "state")
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                       template)

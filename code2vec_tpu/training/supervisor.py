@@ -1,0 +1,284 @@
+"""Crash-recovery supervisor (ISSUE 10 tentpole, layer 3): close the
+detect -> decide -> recover loop.
+
+The stack could already DETECT trouble (PR 6 stall watchdog, PR 7 alert
+engine) and SURVIVE it on disk (PR 5 commit-or-vanish checkpoints) —
+but a killed worker ended the run and waited for a human. `Supervisor`
+makes restart the ordinary path:
+
+  - it spawns the training run as child process(es) — one, or an
+    N-process Gloo cohort with a fresh coordinator port per attempt —
+    and watches their exit codes;
+  - BEFORE every (re)launch it verifies the checkpoint directory
+    (`checkpoint.verify_and_resolve`): a corrupt latest step is
+    quarantined and the child auto-resumes from the last VERIFIED
+    committed step, never from rotten bytes;
+  - any nonzero/signal exit fails the whole attempt: the remaining
+    cohort members get a grace window to die on their own (the Gloo
+    coordination-service heartbeat tolerance evicts the dead peer's
+    partners), then are SIGKILLed, and the cohort relaunches
+    COHERENTLY — never a half-old half-new mix of processes;
+  - a child that simply finishes (all exit 0) ends the supervised run;
+  - the restart budget is bounded, the pacing is the shared
+    `resilience/retry` backoff math, and every decision escalates
+    through the EXISTING alert engine (`supervisor/*` gauges drive
+    edge-triggered `alert` events: restarted -> ticket, quarantined
+    checkpoint -> ticket, budget exhausted -> page).
+
+Frequent checkpointing (Check-N-Run) only pays off when restart is
+automatic and verified; this is the piece that makes it so. The spawn
+function is injectable, so the policy logic tests without real
+training runs; `tools/train_supervisor.py` is the CLI entry and
+`tools/chaos.py` drives the acceptance scenarios (SIGKILL parity,
+corrupt-checkpoint fallback) end to end.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from code2vec_tpu.resilience import retry as retry_mod
+from code2vec_tpu.training import checkpoint as ckpt
+
+__all__ = ["RestartBudgetExceeded", "Supervisor", "build_cli_spawn",
+           "supervisor_alert_rules"]
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The cohort kept dying past `max_restarts` relaunches — a human's
+    problem now; the page-severity alert already fired."""
+
+
+def supervisor_alert_rules():
+    """Escalation through the EXISTING alert engine (ISSUE 7): the
+    supervisor publishes gauges, these rules turn them into
+    edge-triggered `alert` events + stdout lines."""
+    from code2vec_tpu.obs.alerts import AlertRule
+    return [
+        AlertRule("train_process_restarted",
+                  metric="supervisor/restarts", op=">=", value=1,
+                  severity="ticket"),
+        AlertRule("checkpoint_quarantined",
+                  metric="resilience/ckpt_quarantined", op=">=",
+                  value=1, severity="ticket"),
+        # an explicit 0/1 gauge, not `restarts_remaining <= 0`: a
+        # max_restarts=0 supervisor would otherwise page on a run that
+        # SUCCEEDED without ever restarting
+        AlertRule("restart_budget_exhausted",
+                  metric="supervisor/budget_exhausted", op=">=",
+                  value=1, severity="page"),
+    ]
+
+
+class Supervisor:
+    """Restart supervisor over an injectable spawn function.
+
+    `spawn_fn(attempt, proc_id, port) -> subprocess.Popen` launches one
+    cohort member (`port` is a fresh coordinator port per attempt, 0
+    for single-process runs). The supervisor owns reaping: no child
+    outlives a failed attempt (the tests/conftest.py leak-guard
+    discipline).
+    """
+
+    def __init__(self, spawn_fn: Callable[[int, int, int],
+                                          "subprocess.Popen"], *,
+                 num_procs: int = 1, max_restarts: int = 3,
+                 ckpt_dir: Optional[str] = None,
+                 telemetry=None,
+                 log: Optional[Callable[[str], None]] = None,
+                 poll_s: float = 0.2, peer_grace_s: float = 15.0,
+                 attempt_timeout_s: Optional[float] = None,
+                 backoff: Optional[retry_mod.RetryPolicy] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        assert num_procs >= 1 and max_restarts >= 0
+        self._spawn_fn = spawn_fn
+        self.num_procs = num_procs
+        self.max_restarts = max_restarts
+        self.ckpt_dir = ckpt_dir
+        self._log = log or (lambda m: print(m, flush=True))
+        self.poll_s = poll_s
+        self.peer_grace_s = peer_grace_s
+        self.attempt_timeout_s = attempt_timeout_s
+        self._sleep = sleep
+        # ONE backoff math for the whole repo: the supervisor's restart
+        # pacing is the retry policy's delay curve, not a second
+        # implementation
+        self.backoff = backoff if backoff is not None else \
+            retry_mod.RetryPolicy("supervisor-restart", max_attempts=1,
+                                  base_delay_s=1.0, max_delay_s=60.0)
+        if telemetry is None:
+            from code2vec_tpu.obs import Telemetry
+            telemetry = Telemetry.memory("supervisor")
+        self.telemetry = telemetry
+        retry_mod.set_telemetry(telemetry)
+        from code2vec_tpu.obs.alerts import AlertEngine
+        self.alerts = AlertEngine.create(
+            telemetry, mode="warn", rules=supervisor_alert_rules(),
+            log=self._log)
+        self.restarts = 0
+        self.quarantined: List[str] = []
+        self.resumed_from_step: Optional[int] = None
+
+    # ---- checkpoint verification (runs before EVERY launch) ----
+    def verify_checkpoint(self) -> Optional[int]:
+        """Verify + quarantine so the child only ever resumes from a
+        VERIFIED committed step; returns that step (None = fresh
+        start). Quarantines escalate through the alert engine."""
+        if not self.ckpt_dir or not os.path.isdir(self.ckpt_dir):
+            return None
+        good, quarantined = ckpt.verify_and_resolve(
+            self.ckpt_dir, log=self._log)
+        if quarantined:
+            self.quarantined.extend(quarantined)
+            self.telemetry.gauge("resilience/ckpt_quarantined",
+                                 len(self.quarantined), emit=False)
+            self.telemetry.event(
+                "ckpt_quarantine", dirs=quarantined,
+                fallback_step=good)
+            self.alerts.check_now()
+        return good
+
+    # ---- one attempt ----
+    def _kill_all(self, procs: Sequence["subprocess.Popen"]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            if p.poll() is None:
+                p.wait()
+
+    def _reap_with_grace(self, procs: Sequence["subprocess.Popen"]
+                         ) -> None:
+        """A peer died: give the rest `peer_grace_s` to notice (the
+        coordination-service heartbeat eviction takes them down on
+        their own), then SIGKILL the stragglers — the cohort always
+        relaunches whole."""
+        deadline = time.monotonic() + self.peer_grace_s
+        while time.monotonic() < deadline \
+                and any(p.poll() is None for p in procs):
+            self._sleep(self.poll_s)
+        self._kill_all(procs)
+
+    def _run_cohort(self, attempt: int) -> Tuple[bool, List[int]]:
+        from code2vec_tpu.parallel.compat import free_port
+        port = free_port() if self.num_procs > 1 else 0
+        procs = [self._spawn_fn(attempt, i, port)
+                 for i in range(self.num_procs)]
+        deadline = (time.monotonic() + self.attempt_timeout_s
+                    if self.attempt_timeout_s else None)
+        try:
+            while True:
+                rcs = [p.poll() for p in procs]
+                if all(rc is not None for rc in rcs):
+                    return all(rc == 0 for rc in rcs), rcs
+                if any(rc is not None and rc != 0 for rc in rcs):
+                    # dead peer detected: coherent cohort teardown
+                    self._reap_with_grace(procs)
+                    return False, [p.poll() for p in procs]
+                if deadline is not None and time.monotonic() > deadline:
+                    self._log(f"supervisor: attempt {attempt} exceeded "
+                              f"{self.attempt_timeout_s:.0f}s — "
+                              "killing cohort")
+                    self._kill_all(procs)
+                    return False, [p.poll() for p in procs]
+                self._sleep(self.poll_s)
+        finally:
+            self._kill_all(procs)  # no orphan survives any exit path
+
+    # ---- the supervised run ----
+    def run(self) -> int:
+        self.telemetry.gauge("supervisor/restarts", 0, emit=False)
+        self.telemetry.gauge("supervisor/restarts_remaining",
+                             self.max_restarts, emit=False)
+        while True:
+            step = self.verify_checkpoint()
+            if self.restarts > 0 or step is not None:
+                self.resumed_from_step = step
+            self.telemetry.event(
+                "supervisor_launch", attempt=self.restarts,
+                num_procs=self.num_procs,
+                resume_step=step if step is not None else -1)
+            if step is not None:
+                self._log(f"supervisor: launching attempt "
+                          f"{self.restarts} (resume from verified "
+                          f"step {step})")
+            ok, rcs = self._run_cohort(self.restarts)
+            self.telemetry.event("supervisor_attempt",
+                                 attempt=self.restarts, ok=ok,
+                                 exit_codes=rcs)
+            if ok:
+                self._log(f"supervisor: run completed after "
+                          f"{self.restarts} restart(s)")
+                self.alerts.check_now()
+                return 0
+            self.restarts += 1
+            self.telemetry.count("supervisor/attempts_failed")
+            self.telemetry.gauge("supervisor/restarts", self.restarts,
+                                 emit=False)
+            self.telemetry.gauge("supervisor/restarts_remaining",
+                                 self.max_restarts - self.restarts,
+                                 emit=False)
+            self.alerts.check_now()
+            if self.restarts > self.max_restarts:
+                self.telemetry.gauge("supervisor/budget_exhausted", 1,
+                                     emit=False)
+                self.alerts.check_now()  # the page-severity alert
+                self._log(f"supervisor: restart budget exhausted "
+                          f"({self.max_restarts}); exit codes {rcs}")
+                raise RestartBudgetExceeded(
+                    f"training cohort died {self.restarts} times "
+                    f"(budget {self.max_restarts}); last exit codes "
+                    f"{rcs}")
+            delay = self.backoff.delay_s(self.restarts)
+            self._log(f"supervisor: cohort died (exit codes {rcs}); "
+                      f"relaunching in {delay:.2f}s "
+                      f"(restart {self.restarts}/{self.max_restarts})")
+            self._sleep(delay)
+
+
+def build_cli_spawn(child_cmd: Sequence[str], *, num_procs: int = 1,
+                    out_dir: Optional[str] = None,
+                    cpu_devices: Optional[int] = None,
+                    log: Optional[Callable[[str], None]] = None
+                    ) -> Callable[[int, int, int], "subprocess.Popen"]:
+    """Spawn function over a CLI child command (tools/train_supervisor
+    and tools/chaos use this). Multi-process cohorts get the explicit
+    `--dist_*` coordination flags appended per member (fresh port per
+    attempt); `cpu_devices` pins the CPU harness's virtual device count
+    via `parallel/compat.cpu_worker_env`, BEFORE the child's jax
+    import. Child output streams to `attempt<k>.proc<i>.log` under
+    `out_dir` (or inherits the supervisor's stdio)."""
+    child_cmd = list(child_cmd)
+
+    def spawn(attempt: int, proc_id: int, port: int
+              ) -> "subprocess.Popen":
+        cmd = list(child_cmd)
+        if num_procs > 1:
+            cmd += ["--dist_coordinator", f"127.0.0.1:{port}",
+                    "--dist_num_processes", str(num_procs),
+                    "--dist_process_id", str(proc_id)]
+        if cpu_devices is not None:
+            from code2vec_tpu.parallel.compat import cpu_worker_env
+            env = cpu_worker_env(cpu_devices)
+        else:
+            env = dict(os.environ)
+        stdout = None
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            log_path = os.path.join(
+                out_dir, f"attempt{attempt}.proc{proc_id}.log")
+            stdout = open(log_path, "w", encoding="utf-8")
+        if log is not None:
+            log(f"supervisor: spawn attempt={attempt} proc={proc_id}: "
+                f"{' '.join(cmd)}")
+        try:
+            return subprocess.Popen(cmd, env=env, stdout=stdout,
+                                    stderr=subprocess.STDOUT
+                                    if stdout is not None else None)
+        finally:
+            if stdout is not None:
+                stdout.close()  # the child holds its own dup
+    return spawn

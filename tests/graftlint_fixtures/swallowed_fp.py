@@ -1,0 +1,72 @@
+"""swallowed-error tricky FALSE positives: handlers that look like
+swallows but either act on the error or sit on a sanctioned path."""
+import logging
+import queue
+
+log = logging.getLogger(__name__)
+
+
+def narrow_except_is_documentation(q):
+    # naming the exception IS the handling: not broad, not flagged
+    while True:
+        try:
+            return q.get_nowait()
+        except queue.Empty:
+            continue
+
+
+def logged_swallow(fn):
+    try:
+        fn()
+    except Exception as e:
+        log.warning("best-effort probe failed: %s", e)
+
+
+def fallback_assignment(fn):
+    try:
+        value = fn()
+    except Exception:
+        value = None  # explicit fallback: the error chose a value
+    return value
+
+
+def sticky_error_stash(fn, sink):
+    # the AsyncCheckpointWriter pattern: the error is RECORDED, it
+    # re-raises at the next barrier
+    try:
+        fn()
+    except BaseException as e:
+        sink.error = e
+
+
+def reraise_after_cleanup(fn, tmp):
+    try:
+        fn()
+    except Exception:
+        tmp.unlink()
+        raise
+
+
+class Pool:
+    def close(self):
+        # sanctioned teardown: best-effort cleanup may swallow
+        try:
+            self._pool.shutdown()
+        except Exception:
+            pass
+
+    def drain_quiet(self):
+        try:
+            self._pump()
+        except Exception:
+            pass
+
+
+def finally_block_teardown(fn, conn):
+    try:
+        return fn()
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass  # teardown under finally: the real error is in flight

@@ -1,0 +1,57 @@
+"""swallowed-error TRUE positives: broad excepts whose bodies erase the
+failure with no log, re-raise, or fallback."""
+
+
+def classic_pass(fn):
+    try:
+        return fn()
+    except Exception:
+        pass
+
+
+def bound_but_unused(fn):
+    try:
+        return fn()
+    except Exception as e:  # noqa: F841 — bound, then dropped
+        pass
+
+
+def bare_except_continue(items):
+    out = []
+    for it in items:
+        try:
+            out.append(it())
+        except:  # noqa: E722
+            continue
+    return out
+
+
+def base_exception_pass(fn):
+    try:
+        fn()
+    except BaseException:
+        pass
+
+
+def broad_inside_tuple(fn):
+    try:
+        fn()
+    except (ValueError, Exception):
+        pass
+
+
+def docstring_only_body(fn):
+    try:
+        fn()
+    except Exception:
+        """Intentionally ignored."""
+
+
+def not_a_teardown_name(fn):
+    # `closest` is not `close`: the sanction matches names, not prefixes
+    def closest():
+        try:
+            fn()
+        except Exception:
+            pass
+    return closest

@@ -128,6 +128,17 @@ def test_config_drift_fixtures():
     assert fp == [], "\n".join(f.render() for f in fp)
 
 
+def test_swallowed_error_fixtures():
+    tp = _rule_findings("swallowed-error", [_fx("swallowed_tp.py")])
+    assert {f.symbol for f in tp} == {
+        "classic_pass", "bound_but_unused", "bare_except_continue",
+        "base_exception_pass", "broad_inside_tuple",
+        "docstring_only_body", "closest"}
+    assert all("swallows the error" in f.message for f in tp)
+    fp = _rule_findings("swallowed-error", [_fx("swallowed_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
 def test_marker_fixtures():
     rule = all_rules()["test-marker-hygiene"]
     tp = list(rule.check_ctx(FileContext(_fx("markers_tp.py"), REPO),
@@ -194,10 +205,15 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     bad_parallel = Finding("host-sync-in-hot-path",
                            "code2vec_tpu/parallel/distributed.py",
                            1, "m", "s")
+    bad_resilience = Finding("swallowed-error",
+                             "code2vec_tpu/resilience/retry.py",
+                             1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
     refused = baseline_mod.write(
-        [bad, bad_training, bad_ops, bad_parallel, ok], path)
-    assert refused == [bad, bad_training, bad_ops, bad_parallel]
+        [bad, bad_training, bad_ops, bad_parallel, bad_resilience, ok],
+        path)
+    assert refused == [bad, bad_training, bad_ops, bad_parallel,
+                       bad_resilience]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 

@@ -241,27 +241,34 @@ def test_tensorboard_scalars_written(dataset, tmp_path):
 
 
 def test_auto_resume_via_cli_dispatch(dataset, tmp_path, monkeypatch):
-    """--auto_resume turns a rerun of the same command into a resume:
-    second invocation loads the checkpoint the first one saved."""
+    """--auto_resume turns a rerun into a resume OF ITSELF (round-15
+    semantics, the supervisor's contract): the restored step counts
+    toward NUM_TRAIN_EPOCHS, so a partially-finished run trains only
+    the REMAINING epochs and a completed run's rerun is a no-op — not
+    the old behavior of training the full epoch budget again."""
     import sys
 
     import code2vec as cli
     ckpt = str(tmp_path / "ckpt")
-    argv = ["code2vec.py", "--data", dataset, "--save", ckpt,
-            "--epochs", "1", "--batch_size", "32", "--max_contexts",
-            "16", "--auto_resume"]
-    monkeypatch.setattr(sys, "argv", argv)
-    assert cli.main() == 0
-    from code2vec_tpu.training.checkpoint import latest_step
-    step1 = latest_step(ckpt)
-    assert step1 and step1 > 0
 
-    # same command line again: must RESUME (step count advances from
-    # the restored step, not from zero)
-    monkeypatch.setattr(sys, "argv", argv)
-    assert cli.main() == 0
-    step2 = latest_step(ckpt)
+    def run(epochs):
+        monkeypatch.setattr(sys, "argv", [
+            "code2vec.py", "--data", dataset, "--save", ckpt,
+            "--epochs", str(epochs), "--batch_size", "32",
+            "--max_contexts", "16", "--auto_resume"])
+        assert cli.main() == 0
+        from code2vec_tpu.training.checkpoint import latest_step
+        return latest_step(ckpt)
+
+    step1 = run(1)
+    assert step1 and step1 > 0
+    # raise the epoch budget: the rerun resumes from the checkpoint and
+    # trains exactly the ONE remaining epoch
+    step2 = run(2)
     assert step2 == 2 * step1
+    # rerun the COMPLETED command: nothing left to train, step unchanged
+    step3 = run(2)
+    assert step3 == step2
 
 
 def test_auto_resume_ignores_torn_checkpoint_dir(dataset, tmp_path,

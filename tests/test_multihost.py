@@ -34,12 +34,15 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 @pytest.fixture(scope="module")
 def two_process_results(tmp_path_factory):
     from code2vec_tpu.parallel.compat import free_port
+    from code2vec_tpu.resilience import retry as retry_mod
 
     # Gloo over loopback TCP has a documented transient transport race
-    # (compat docstring; tools/multichip_bench.py retries its rep
-    # pairs for the same reason) — one retry on a fresh port keeps the
-    # fixture from turning a platform hiccup into 6 tier-1 errors.
-    for attempt in range(2):
+    # (compat docstring) — one retry on a fresh port keeps the fixture
+    # from turning a platform hiccup into 6 tier-1 errors. The retry
+    # IS the shared resilience policy (ISSUE 10): the hand-rolled
+    # attempt loop this fixture and tools/multichip_bench.py each
+    # carried lives in code2vec_tpu/resilience/retry.py now.
+    def spawn_once():
         out_dir = str(tmp_path_factory.mktemp("mp"))
         port = free_port()
         env = dict(os.environ)
@@ -57,11 +60,16 @@ def two_process_results(tmp_path_factory):
                 if p.poll() is None:
                     p.kill()
                     p.wait()
-        if all(p.returncode == 0 for p in procs):
-            return {i: np.load(os.path.join(out_dir, f"proc{i}.npz"))
-                    for i in range(2)}
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}"
+        if not all(p.returncode == 0 for p in procs):
+            raise RuntimeError("worker failed:\n" + "\n".join(
+                f"proc{i} rc={p.returncode}:\n{out}"
+                for i, (p, out) in enumerate(zip(procs, outs))))
+        return {i: np.load(os.path.join(out_dir, f"proc{i}.npz"))
+                for i in range(2)}
+
+    return retry_mod.transient_distributed(
+        "two-process-fixture", max_attempts=2,
+        base_delay_s=0.1).call(spawn_once)
 
 
 def test_two_processes_agree(two_process_results):

@@ -1,0 +1,645 @@
+"""Resilience layer (ISSUE 10): deterministic fault injection, the
+unified retry policy, checkpoint integrity (checksums / verify /
+quarantine), the ENOSPC-mid-async-save contract, extractor-pool
+restart-in-place, and the restart supervisor's policy logic (with
+real—but trivial—child processes)."""
+
+import errno
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.resilience import FaultInjected, RetryPolicy, faults
+from code2vec_tpu.resilience import retry as retry_mod
+from code2vec_tpu.training import checkpoint as ckpt
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, Vocab, \
+    VocabType
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tiny_vocabs():
+    return Code2VecVocabs(Vocab(VocabType.Token, ["a", "b"]),
+                          Vocab(VocabType.Path, ["1"]),
+                          Vocab(VocabType.Target, ["t"]))
+
+
+def _tiny_dims():
+    return ModelDims(token_vocab_size=4, path_vocab_size=3,
+                     target_vocab_size=3, embeddings_size=4,
+                     max_contexts=4, dropout_keep_rate=1.0)
+
+
+def _tiny_state(step=1, fill=0.0):
+    return {"params": {"w": np.full((3, 4), fill, np.float32)},
+            "step": step}
+
+
+def _flip_byte(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _state_files(step_dir):
+    out = []
+    for base, _d, fs in os.walk(os.path.join(step_dir, "state")):
+        out += [os.path.join(base, f) for f in fs]
+    return out
+
+
+# ---------------------------------------------------------------- faults
+
+def test_fault_at_and_times_are_deterministic():
+    faults.install({"seed": 0, "sites": {
+        "s": {"action": "raise", "at": 3, "times": 2}}})
+    fired = []
+    for i in range(1, 7):
+        try:
+            faults.fire("s")
+            fired.append(False)
+        except FaultInjected:
+            fired.append(True)
+    # hits 3 and 4 fire (times=2), nothing before or after
+    assert fired == [False, False, True, True, False, False]
+    assert faults.stats()["s"] == {"hits": 6, "fired": 2}
+
+
+def test_fault_prob_stream_is_seeded():
+    def firing_hits(seed):
+        faults.install({"seed": seed, "sites": {
+            "p": {"action": "raise", "prob": 0.3, "times": -1}}},
+            log=lambda _m: None)
+        out = []
+        for i in range(40):
+            try:
+                faults.fire("p")
+            except FaultInjected:
+                out.append(i)
+        return out
+
+    a, b, c = firing_hits(7), firing_hits(7), firing_hits(8)
+    assert a == b               # same seed -> same failure schedule
+    assert a != c               # different seed -> different schedule
+    assert 2 < len(a) < 25      # ~30% of 40
+
+
+def test_fault_marker_is_a_cross_restart_once_latch(tmp_path):
+    marker = str(tmp_path / "once")
+    spec = {"seed": 0, "sites": {
+        "k": {"action": "raise", "at": 1, "marker": marker}}}
+    faults.install(spec)
+    with pytest.raises(FaultInjected):
+        faults.fire("k")
+    assert os.path.exists(marker)
+    # a "restarted process" (fresh registry, same spec) stays disarmed
+    faults.install(spec)
+    for _ in range(3):
+        faults.fire("k")
+    assert faults.stats()["k"]["fired"] == 0
+
+
+def test_fault_io_error_with_partial_leaves_torn_marker(tmp_path):
+    faults.install({"seed": 0, "sites": {
+        "ckpt/write": {"action": "io_error", "errno": "ENOSPC",
+                       "partial": True}}})
+    step_dir = str(tmp_path / "step_9")
+    with pytest.raises(OSError) as ei:
+        faults.fire("ckpt/write", path=step_dir)
+    assert ei.value.errno == errno.ENOSPC
+    # the torn orbax temp marker exists, the committed `state` does not
+    assert os.path.isdir(os.path.join(step_dir,
+                                      "state.orbax-checkpoint-tmp"))
+    assert not os.path.exists(os.path.join(step_dir, "state"))
+
+
+def test_disarmed_sites_are_null_handles():
+    p = faults.point("train/kill")
+    assert not p.armed
+    p.fire()            # no-op
+    assert not p.hit()
+    faults.fire("anything")  # no registry: one None check
+    # armed registry, unconfigured site -> still the null handle
+    faults.install({"seed": 0, "sites": {"other": {"action": "raise"}}},
+                   log=lambda _m: None)
+    assert not faults.point("train/kill").armed
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="action"):
+        faults.install({"sites": {"s": {"action": "explode"}}})
+    with pytest.raises(ValueError, match="unknown spec"):
+        faults.install({"sites": {"s": {"action": "raise",
+                                        "tyop": 1}}})
+    with pytest.raises(ValueError, match="sites"):
+        faults.install({"seed": 3})
+
+
+# ----------------------------------------------------------------- retry
+
+def test_retry_succeeds_within_budget_and_records():
+    sleeps = []
+    pol = RetryPolicy("t", max_attempts=3, base_delay_s=0.1, seed=0,
+                      sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert retry_mod.stats()["t"]["retries"] >= 2
+
+
+def test_retry_exhausted_reraises_original():
+    pol = RetryPolicy("x", max_attempts=2, base_delay_s=0,
+                      sleep=lambda _s: None)
+    with pytest.raises(ValueError, match="boom"):
+        pol.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert retry_mod.stats()["x"]["exhausted"] >= 1
+
+
+def test_retry_giveup_skips_backoff_entirely():
+    sleeps = []
+    pol = RetryPolicy("g", max_attempts=5, base_delay_s=1.0,
+                      sleep=sleeps.append, retry_on=(OSError,),
+                      giveup=lambda e: e.errno == errno.ENOSPC)
+    with pytest.raises(OSError):
+        pol.call(lambda: (_ for _ in ()).throw(
+            OSError(errno.ENOSPC, "full")))
+    assert sleeps == []
+
+
+def test_retry_backoff_curve_is_jittered_exponential():
+    pol = RetryPolicy("b", max_attempts=9, base_delay_s=0.1,
+                      max_delay_s=1.0, multiplier=2.0, jitter=0.5,
+                      seed=0)
+    for attempt, ceiling in ((1, 0.1), (2, 0.2), (3, 0.4), (6, 1.0)):
+        d = pol.delay_s(attempt)
+        assert ceiling * 0.5 <= d <= ceiling, (attempt, d)
+    # seeded stream is reproducible
+    a = RetryPolicy("b2", seed=3).delay_s(2)
+    b = RetryPolicy("b2", seed=3).delay_s(2)
+    assert a == b
+
+
+def test_retry_telemetry_counters_and_events():
+    from code2vec_tpu.obs import Telemetry
+    tele = Telemetry.memory("t")
+    retry_mod.set_telemetry(tele)
+    try:
+        pol = RetryPolicy("tele", max_attempts=2, base_delay_s=0,
+                          sleep=lambda _s: None)
+        calls = {"n": 0}
+
+        def once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("x")
+            return 1
+
+        assert pol.call(once) == 1
+        assert tele.counters["resilience/retry"] == 1
+    finally:
+        retry_mod.set_telemetry(None)
+
+
+# ----------------------------------- checkpoint integrity + quarantine
+
+def test_checksums_written_and_verify_roundtrip(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, _tiny_state(1), 1, _tiny_vocabs(),
+                         _tiny_dims())
+    man = os.path.join(d, "step_1", ckpt.CHECKSUMS_NAME)
+    assert os.path.exists(man)
+    with open(man) as f:
+        payload = json.load(f)
+    assert payload["step"] == 1 and payload["files"]
+    assert ckpt.verify_step(d, 1) is True
+    # no-checksums step (pre-integrity checkpoint): None, not False
+    os.remove(man)
+    assert ckpt.verify_step(d, 1) is None
+
+
+def test_bit_flip_detected_quarantined_and_fallback(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, _tiny_state(1, fill=1.0), 1,
+                         _tiny_vocabs(), _tiny_dims())
+    ckpt.save_checkpoint(d, _tiny_state(2, fill=2.0), 2,
+                         _tiny_vocabs(), _tiny_dims())
+    _flip_byte(max(_state_files(os.path.join(d, "step_2")),
+                   key=os.path.getsize))
+    assert ckpt.verify_step(d, 2) is False
+    good, quarantined = ckpt.verify_and_resolve(d)
+    assert good == 1 and len(quarantined) == 1
+    assert os.path.isdir(os.path.join(d, "quarantine", "step_2"))
+    assert ckpt.latest_step(d) == 1  # quarantine is invisible
+    restored = ckpt.load_checkpoint(d, _tiny_state(0))
+    assert int(np.asarray(restored["step"])) == 1
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.full((3, 4), 1.0, np.float32))
+
+
+def test_load_checkpoint_quarantines_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, _tiny_state(1, fill=3.0), 1,
+                         _tiny_vocabs(), _tiny_dims())
+    ckpt.save_checkpoint(d, _tiny_state(2, fill=4.0), 2,
+                         _tiny_vocabs(), _tiny_dims())
+    _flip_byte(max(_state_files(os.path.join(d, "step_2")),
+                   key=os.path.getsize))
+    restored = ckpt.load_checkpoint(d, _tiny_state(0))
+    assert int(np.asarray(restored["step"])) == 1
+    assert os.path.isdir(os.path.join(d, "quarantine", "step_2"))
+    # an EXPLICITLY requested corrupt step raises instead of
+    # substituting different bytes
+    ckpt.save_checkpoint(d, _tiny_state(5, fill=5.0), 5,
+                         _tiny_vocabs(), _tiny_dims())
+    _flip_byte(max(_state_files(os.path.join(d, "step_5")),
+                   key=os.path.getsize))
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_checkpoint(d, _tiny_state(0), step=5)
+
+
+def test_transient_ckpt_io_error_is_retried(tmp_path):
+    # EIO twice, then clean: the write succeeds through the policy
+    faults.install({"seed": 0, "sites": {
+        "ckpt/write": {"action": "io_error", "errno": "EIO",
+                       "times": 2}}}, log=lambda _m: None)
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, _tiny_state(1), 1, _tiny_vocabs(),
+                         _tiny_dims())
+    assert ckpt.latest_step(d) == 1
+    assert faults.stats()["ckpt/write"]["fired"] == 2
+
+
+# ------------------------------------- ENOSPC mid-async-save satellite
+
+def test_enospc_mid_async_save_sticky_then_recovers(tmp_path):
+    """The satellite contract: ENOSPC during a background save (a)
+    surfaces as a sticky error at the commit barrier, (b) leaves the
+    partial step dir invisible to latest_step, and (c) the next save
+    on a recovered disk succeeds."""
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, _tiny_state(1), 1, _tiny_vocabs(),
+                         _tiny_dims())
+    faults.install({"seed": 0, "sites": {
+        "ckpt/write": {"action": "io_error", "errno": "ENOSPC",
+                       "partial": True}}}, log=lambda _m: None)
+    writer = ckpt.AsyncCheckpointWriter()
+    writer.submit(d, _tiny_state(2), 2, _tiny_vocabs(), _tiny_dims())
+    with pytest.raises(OSError) as ei:
+        writer.wait()  # (a) sticky at the barrier
+    assert ei.value.errno == errno.ENOSPC
+    # (b) the torn step_2 exists but never counts
+    assert os.path.isdir(os.path.join(d, "step_2"))
+    assert ckpt.latest_step(d) == 1
+    # (c) disk "recovers": the SAME writer's next save commits
+    faults.clear()
+    writer.submit(d, _tiny_state(3), 3, _tiny_vocabs(), _tiny_dims())
+    writer.wait()
+    writer.close()
+    assert ckpt.latest_step(d) == 3
+    assert ckpt.verify_step(d, 3) is True
+
+
+# -------------------------------------------------- infeed failpoint
+
+def test_infeed_produce_fault_surfaces_at_consumer():
+    from code2vec_tpu.data.prefetch import build_train_infeed
+    faults.install({"seed": 0, "sites": {
+        "infeed/produce": {"action": "raise", "at": 3}}},
+        log=lambda _m: None)
+    infeed = build_train_infeed(
+        [1, 2, 3, 4, 5], chunk=1, depth=2, mesh=None,
+        host_arrays_fn=lambda b: (b,), device_batch_fn=lambda b: b,
+        log=lambda _m: None)
+    seen = []
+    with pytest.raises(FaultInjected):
+        for dev, host in infeed:
+            seen.append(host)
+    assert seen == [1, 2]  # batches before the injected failure landed
+
+
+# ------------------------------------- extractor pool restart-in-place
+
+@pytest.fixture
+def py_source(tmp_path):
+    p = tmp_path / "demo.py"
+    p.write_text("def add_one(x):\n    y = x + 1\n    return y\n")
+    return str(p)
+
+
+def _pool(telemetry=None):
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.serving.extractor import ExtractorPool
+    cfg = Config(SERVE_EXTRACT_WORKERS=2)
+    cfg.train_data_path = "unused"
+    return ExtractorPool(cfg, telemetry=telemetry, language="python")
+
+
+def test_extractor_pool_restarts_in_place_after_crash(py_source):
+    """ISSUE 10 satellite: a worker crash restarts the pool instead of
+    failing every subsequent request; requests racing the restart shed
+    with ServerOverloaded; the next request succeeds."""
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.serving.batcher import ServerOverloaded
+    from code2vec_tpu.serving.extractor import Extractor
+    tele = Telemetry.memory("serve").make_threadsafe()
+    pool = _pool(telemetry=tele)
+    names, lines = pool.extract_paths(py_source)
+    assert names == ["add|one"]
+
+    # hold the rebuild open so the shed window is observable: the
+    # restart thread's preflight blocks until we release it
+    import threading
+    gate = threading.Event()
+    real_preflight = Extractor.preflight
+
+    def gated_preflight(self):
+        gate.wait(timeout=10)
+        return real_preflight(self)
+
+    faults.install({"seed": 0, "sites": {
+        "serve/extract": {"action": "raise", "at": 1}}},
+        log=lambda _m: None)
+    try:
+        Extractor.preflight = gated_preflight
+        with pytest.raises(FaultInjected):
+            pool.extract_paths(py_source)  # the crash itself re-raises
+        deadline = time.monotonic() + 5
+        while not pool.restarting and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.restarting
+        with pytest.raises(ServerOverloaded):
+            pool.extract_paths(py_source)  # shed while restarting
+    finally:
+        Extractor.preflight = real_preflight
+        gate.set()
+    deadline = time.monotonic() + 5
+    while pool.restarting and time.monotonic() < deadline:
+        time.sleep(0.01)
+    names, _ = pool.extract_paths(py_source)  # restarted pool serves
+    assert names == ["add|one"]
+    assert tele.counters["serve/extractor_restart"] == 1
+    assert tele.counters["serve/shed"] >= 1
+    pool.close()
+
+
+def test_extractor_pool_goes_dead_when_rebuild_exhausts(py_source,
+                                                        monkeypatch):
+    from code2vec_tpu.serving.extractor import Extractor, ExtractorError
+    pool = _pool()
+    # every rebuild preflight fails: the retry budget exhausts and the
+    # pool goes dead with the terminal error, not a hang
+    monkeypatch.setattr(
+        Extractor, "preflight",
+        lambda self: (_ for _ in ()).throw(
+            ExtractorError("binary gone; build_extractor.sh")))
+    faults.install({"seed": 0, "sites": {
+        "serve/extract": {"action": "raise", "at": 1}}},
+        log=lambda _m: None)
+    with pytest.raises(FaultInjected):
+        pool.extract_paths(py_source)
+    deadline = time.monotonic() + 10
+    while pool.restarting and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(ExtractorError, match="build_extractor.sh"):
+        pool.extract_paths(py_source)
+    pool.close()
+
+
+def test_per_input_failure_does_not_restart_pool(tmp_path):
+    from code2vec_tpu.serving.extractor import ExtractorError
+    pool = _pool()
+    bad = tmp_path / "empty.py"
+    bad.write_text("# no functions here\n")
+    with pytest.raises(ExtractorError, match="no methods"):
+        pool.extract_paths(str(bad))
+    assert not pool.restarting  # ExtractorError is per-input, no crash
+    pool.close()
+
+
+# ------------------------------------------------------- supervisor
+
+def _sh_spawn(script_for_attempt, out_dir):
+    """Spawn fn over trivial python children; script_for_attempt maps
+    the attempt number to per-process python source."""
+    def spawn(attempt, proc_id, port):
+        return subprocess.Popen(
+            [sys.executable, "-c",
+             script_for_attempt(attempt, proc_id, port)])
+    return spawn
+
+
+def _supervisor(spawn, **kw):
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.training.supervisor import Supervisor
+    kw.setdefault("backoff", RetryPolicy("s", max_attempts=1,
+                                         base_delay_s=0.01, seed=0))
+    kw.setdefault("poll_s", 0.02)
+    kw.setdefault("peer_grace_s", 0.3)
+    kw.setdefault("telemetry", Telemetry.memory("supervisor"))
+    return Supervisor(spawn, **kw)
+
+
+def test_supervisor_restarts_until_success():
+    sup = _supervisor(
+        _sh_spawn(lambda a, p, port:
+                  f"import sys; sys.exit(0 if {a} >= 2 else 1)", None),
+        max_restarts=3)
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    assert sup.telemetry.gauges["supervisor/restarts"] == 2
+    # the restart alert fired exactly once (edge-triggered)
+    assert sup.telemetry.counters.get("alerts/fired", 0) == 1
+
+
+def test_supervisor_budget_exhaustion_pages_and_raises():
+    from code2vec_tpu.training.supervisor import RestartBudgetExceeded
+    sup = _supervisor(
+        _sh_spawn(lambda a, p, port: "import sys; sys.exit(1)", None),
+        max_restarts=1)
+    with pytest.raises(RestartBudgetExceeded):
+        sup.run()
+    assert sup.telemetry.gauges["supervisor/budget_exhausted"] == 1
+    table = {r["rule"]: r["state"]
+             for r in sup.alerts.status_table()}
+    assert table["restart_budget_exhausted"] == "firing"
+
+
+def test_supervisor_dead_peer_reaps_and_relaunches_cohort():
+    """One member dies, the survivor would run 30s more: the grace
+    window expires, the survivor is killed, and the NEXT attempt's
+    whole cohort (exit 0) ends the run — coherent relaunch."""
+    def script(attempt, proc_id, port):
+        if attempt == 0 and proc_id == 1:
+            return "import sys; sys.exit(9)"
+        if attempt == 0:
+            return "import time; time.sleep(30)"
+        return f"import sys; sys.exit(0)  # port {port}"
+
+    t0 = time.monotonic()
+    sup = _supervisor(_sh_spawn(script, None), num_procs=2,
+                      max_restarts=2)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert time.monotonic() - t0 < 20  # never waited out the sleeper
+
+
+def test_supervisor_verifies_and_quarantines_before_launch(tmp_path):
+    """The corrupt-checkpoint contract's fast half (the full
+    subprocess scenario is tools/chaos.py corrupt_checkpoint,
+    slow-marked): a bit-flipped latest step is detected BEFORE launch,
+    quarantined, the run resumes from the prior committed step, and an
+    edge-triggered `alert` JSONL event is emitted through the
+    engine."""
+    from code2vec_tpu.obs import Telemetry
+    d = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(d, _tiny_state(1, fill=1.0), 1,
+                         _tiny_vocabs(), _tiny_dims())
+    ckpt.save_checkpoint(d, _tiny_state(2, fill=2.0), 2,
+                         _tiny_vocabs(), _tiny_dims())
+    _flip_byte(max(_state_files(os.path.join(d, "step_2")),
+                   key=os.path.getsize))
+    tele = Telemetry.create(str(tmp_path / "tele"),
+                            component="supervisor")
+    sup = _supervisor(
+        _sh_spawn(lambda a, p, port: "import sys; sys.exit(0)", None),
+        max_restarts=0, ckpt_dir=d, telemetry=tele)
+    assert sup.run() == 0
+    tele.close()
+    assert sup.resumed_from_step == 1
+    assert len(sup.quarantined) == 1
+    assert sup.telemetry.gauges["resilience/ckpt_quarantined"] == 1
+    table = {r["rule"]: r["state"] for r in sup.alerts.status_table()}
+    assert table["checkpoint_quarantined"] == "firing"
+    with open(os.path.join(tele.run_dir, "events.jsonl"),
+              encoding="utf-8") as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    alerts = [e for e in events if e["kind"] == "alert"
+              and e["rule"] == "checkpoint_quarantined"]
+    assert [a["transition"] for a in alerts] == ["firing"]
+    assert any(e["kind"] == "ckpt_quarantine" for e in events)
+
+
+def test_train_supervisor_cli_appends_auto_resume(tmp_path, capsys):
+    """The CLI wrapper: a --save child gets --auto_resume appended, a
+    trivially-succeeding child yields exit 0."""
+    from tools.train_supervisor import main
+    marker = tmp_path / "ran"
+    rc = main(["--max_restarts", "0", "--backoff_base_s", "0.01",
+               "--out_dir", str(tmp_path / "logs"), "--",
+               sys.executable, "-c",
+               f"import sys, pathlib; "
+               f"pathlib.Path(r'{marker}').write_text("
+               f"' '.join(sys.argv)); sys.exit(0)",
+               "--save", str(tmp_path / "ckpt")])
+    assert rc == 0
+    assert "--auto_resume" in marker.read_text()
+    out = capsys.readouterr().out
+    assert "appending it" in out
+
+
+# ----------------------------------------- resume math (epoch offset)
+
+def test_steps_per_epoch_matches_reader_alignment():
+    from code2vec_tpu.data.reader import steps_per_epoch
+    assert steps_per_epoch(96, 32) == 3
+    assert steps_per_epoch(97, 32) == 4
+    # H=2, 17 examples, B=8: hosts align at 2 (test_multihost's case)
+    assert steps_per_epoch(17, 8, 2) == 2
+
+
+def test_auto_resume_replays_cosine_trajectory_exactly(tmp_path):
+    """Auto-resume parity is SCHEDULE-agnostic: under --auto_resume
+    the LR horizon stays the ORIGINAL epochs x steps-per-epoch (no
+    `+ restored_step` extension — that is fine-tune semantics), so a
+    run resumed from its own epoch-1 checkpoint finishes with params
+    bit-identical to the uninterrupted run even under cosine decay
+    (review finding: the horizon used to double-count and skew every
+    resumed step's LR)."""
+    import shutil
+
+    import jax
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from tests.helpers import build_tiny_dataset
+    from tests.test_model import tiny_config
+    ds = tmp_path / "ds"
+    ds.mkdir()
+    prefix = build_tiny_dataset(str(ds), n_train=96, n_val=8, n_test=8,
+                                max_contexts=8)
+
+    def run(cfg):
+        model = Code2VecModel(cfg)
+        model.train()
+        model.close_session()
+        return model
+
+    full_dir = str(tmp_path / "full")
+    cfg = tiny_config(prefix, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_EPOCHS=1,
+                      LR_SCHEDULE="cosine", save_path=full_dir,
+                      MAX_CONTEXTS=8)
+    cfg.test_data_path = None
+    oracle = run(cfg)
+    spe = oracle.step_num // 2
+
+    # reconstruct "killed after epoch 1": the oracle's OWN epoch-1
+    # checkpoint + sidecars in a fresh dir
+    resume_dir = str(tmp_path / "resumed")
+    os.makedirs(resume_dir)
+    shutil.copytree(os.path.join(full_dir, f"step_{spe}"),
+                    os.path.join(resume_dir, f"step_{spe}"))
+    for sidecar in ("manifest.json", "vocab.pkl"):
+        shutil.copy(os.path.join(full_dir, sidecar),
+                    os.path.join(resume_dir, sidecar))
+    cfg2 = tiny_config(prefix, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_EPOCHS=1,
+                       LR_SCHEDULE="cosine", save_path=resume_dir,
+                       AUTO_RESUME=True, load_path=resume_dir,
+                       MAX_CONTEXTS=8)
+    cfg2.test_data_path = None
+    resumed = run(cfg2)
+    assert resumed.step_num == oracle.step_num
+    for key in oracle.params:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(oracle.params[key])),
+            np.asarray(jax.device_get(resumed.params[key])), err_msg=key)
+
+
+def test_reader_epoch_offset_replays_the_interrupted_stream(tmp_path):
+    from code2vec_tpu.data.reader import open_reader
+    from tests.helpers import build_tiny_dataset, load_tiny_vocabs
+    prefix = build_tiny_dataset(str(tmp_path), n_train=48, n_val=8,
+                                n_test=8, max_contexts=8)
+    vocabs = load_tiny_vocabs(prefix)
+
+    def epoch_batches(reader):
+        return [b.target_index.copy() for b in reader]
+
+    cold = open_reader(prefix + ".train.c2v", vocabs, 8, 16,
+                       shuffle=True, seed=5)
+    first, second = epoch_batches(cold), epoch_batches(cold)
+    resumed = open_reader(prefix + ".train.c2v", vocabs, 8, 16,
+                          shuffle=True, seed=5, epoch_offset=1)
+    replay = epoch_batches(resumed)
+    for a, b in zip(second, replay):
+        np.testing.assert_array_equal(a, b)
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(first, replay))

@@ -1,0 +1,429 @@
+#!/usr/bin/env python3
+"""Chaos scenario runner (ISSUE 10): exercise the detect -> decide ->
+recover loop end to end, deterministically, on the CPU harness.
+
+Each scenario builds a tiny synthetic dataset, runs REAL
+`code2vec.py` training processes under the REAL supervisor
+(training/supervisor.py) with a `--faults` spec arming the relevant
+failpoint, and asserts the recovery contract:
+
+  kill_resume        SIGKILL the (1-process) training run mid-epoch
+                     under constant LR; the supervisor relaunches it
+                     with --auto_resume and the final checkpoint is
+                     BIT-IDENTICAL to an uninterrupted run's — the
+                     step-keyed rng + resumed shuffle stream replay
+                     the exact trajectory (the chaos-parity
+                     acceptance). Tier-1 smoke: tests/test_chaos.py.
+  kill_resume_2proc  Same contract through the 2-process Gloo cohort:
+                     SIGKILL worker 1 mid-epoch, the supervisor
+                     detects the dead peer, reaps the survivor, and
+                     relaunches the WHOLE cohort coherently on a
+                     fresh port (slow-marked test).
+  corrupt_checkpoint Bit-flip a leaf blob in the latest committed
+                     step; the supervisor's pre-launch verification
+                     detects it, QUARANTINES the step dir, emits an
+                     `alert` event through the alert engine, and the
+                     run resumes from the prior committed step.
+
+Usage (repo root):
+
+  python tools/chaos.py --list
+  python tools/chaos.py kill_resume --out /tmp/chaos
+  python tools/chaos.py corrupt_checkpoint --out /tmp/chaos
+
+Prints a JSON result per scenario; exit 0 = contract held, 1 = it did
+not. The fault markers make every kill a cross-restart once-latch, so
+a scenario is a TEST, not a dice roll.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# tiny-but-learnable synthetic corpus (the tests/helpers.py shape,
+# re-stated here so a TOOL does not import the test tree)
+_TOKENS = ["foo", "bar", "baz", "qux", "value", "name", "index", "count"]
+_PATHS = [str(h) for h in (123456, -98765, 424242, 1337, -777, 31415)]
+_TARGETS = ["get|value", "set|value", "get|name", "set|name",
+            "add|item", "remove|item", "to|string", "is|empty"]
+
+
+def _raw_lines(n: int, seed: int, max_ctx: int) -> list:
+    rng = random.Random(seed)
+    lines = []
+    for _ in range(n):
+        t = rng.randrange(len(_TARGETS))
+        ctxs = []
+        for _ in range(rng.randint(1, max_ctx)):
+            a = _TOKENS[(t + rng.randrange(2)) % len(_TOKENS)]
+            b = _TOKENS[(t * 3 + rng.randrange(2)) % len(_TOKENS)]
+            p = _PATHS[t % len(_PATHS)] if rng.random() < 0.7 \
+                else rng.choice(_PATHS)
+            ctxs.append(f"{a},{p},{b}")
+        lines.append(_TARGETS[t] + " " + " ".join(ctxs))
+    return lines
+
+
+def build_dataset(out_dir: str, *, n_train: int = 96,
+                  max_contexts: int = 8) -> str:
+    from code2vec_tpu.data import preprocess as preprocess_mod
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {}
+    for split, n, seed in (("train", n_train, 1), ("val", 16, 2),
+                           ("test", 16, 3)):
+        p = os.path.join(out_dir, f"raw.{split}.txt")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("\n".join(_raw_lines(n, seed, max_contexts)) + "\n")
+        paths[split] = p
+    prefix = os.path.join(out_dir, "chaos")
+    preprocess_mod.main([
+        "--train_data", paths["train"], "--val_data", paths["val"],
+        "--test_data", paths["test"],
+        "--max_contexts", str(max_contexts),
+        "--word_vocab_size", "1000", "--path_vocab_size", "1000",
+        "--target_vocab_size", "1000", "--output_name", prefix])
+    return prefix
+
+
+def train_cmd(prefix: str, save_dir: str, *, epochs: int,
+              batch: int = 32, max_contexts: int = 8) -> list:
+    """Constant LR (the parity acceptance's requirement: a resumed
+    cosine horizon would legitimately diverge) over the tiny corpus;
+    everything else is the shipped default — async checkpointing
+    included."""
+    return [sys.executable, os.path.join(_REPO, "code2vec.py"),
+            "--data", prefix, "--save", save_dir,
+            "--epochs", str(epochs), "--batch_size", str(batch),
+            "--max_contexts", str(max_contexts),
+            "--lr_schedule", "constant", "--seed", "11"]
+
+
+def _run_plain(cmd: list, *, cpu_devices: int, timeout_s: float) -> None:
+    from code2vec_tpu.parallel.compat import cpu_worker_env
+    r = subprocess.run(cmd, env=cpu_worker_env(cpu_devices),
+                       stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True,
+                       timeout=timeout_s)
+    if r.returncode != 0:
+        raise RuntimeError(f"oracle run failed (rc {r.returncode}):\n"
+                           f"{r.stdout[-4000:]}")
+
+
+def _latest_state(ckpt_dir: str):
+    """Restore the latest committed step onto THIS process's first
+    device, template built from orbax metadata: a cohort-saved
+    checkpoint carries distributed device ids its saver owned, so a
+    template-free restore here would refuse — explicit single-device
+    shardings reshard it instead (the cross-topology restore the
+    checkpoint layer already promises)."""
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from code2vec_tpu.training import checkpoint as ckpt
+    step = ckpt.latest_step(ckpt_dir)
+    assert step is not None, f"no committed checkpoint under {ckpt_dir}"
+    path = os.path.abspath(
+        os.path.join(ckpt_dir, f"step_{step}", "state"))
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    with ocp.StandardCheckpointer() as c:
+        meta = c.metadata(path)
+        def leaf_template(m):
+            if m.shape:
+                return jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                            sharding=sharding)
+            # scalar leaves (step, optimizer counts) restore as plain
+            # python scalars — numpy scalars are not a supported
+            # template type
+            return 0 if np.issubdtype(m.dtype, np.integer) else 0.0
+
+        template = jax.tree_util.tree_map(leaf_template, meta)
+        restored = c.restore(path, template)
+    return step, jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+        restored)
+
+
+def trees_bit_equal(a, b) -> list:
+    """Leaf paths that DIFFER between two restored pytrees (empty =
+    bit-identical)."""
+    import jax
+    import numpy as np
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_flatten_with_path(b)[0]
+    diffs = []
+    if len(la) != len(lb):
+        return ["<structure mismatch>"]
+    for (ka, va), (kb, vb) in zip(la, lb):
+        if ka != kb:
+            diffs.append(f"<key {ka} vs {kb}>")
+        elif not np.array_equal(np.asarray(va), np.asarray(vb)):
+            diffs.append(jax.tree_util.keystr(ka))
+    return diffs
+
+
+def _write_faults(path: str, sites: dict) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"seed": 0, "sites": sites}, f)
+    return path
+
+
+def _supervised(child_cmd: list, *, out: str, num_procs: int = 1,
+                cpu_devices: int = 1, max_restarts: int = 2,
+                ckpt_dir: str, telemetry_dir: str | None = None,
+                attempt_timeout_s: float = 600.0):
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.resilience.retry import RetryPolicy
+    from code2vec_tpu.training.supervisor import (Supervisor,
+                                                  build_cli_spawn)
+
+    def log(msg: str) -> None:
+        print(f"[chaos] {msg}", flush=True)
+
+    telemetry = Telemetry.create(telemetry_dir, component="supervisor",
+                                 log=log) if telemetry_dir else None
+    sup = Supervisor(
+        build_cli_spawn(child_cmd, num_procs=num_procs,
+                        out_dir=os.path.join(out, "logs"),
+                        cpu_devices=cpu_devices, log=log),
+        num_procs=num_procs, max_restarts=max_restarts,
+        ckpt_dir=ckpt_dir, telemetry=telemetry, log=log,
+        peer_grace_s=10.0, attempt_timeout_s=attempt_timeout_s,
+        backoff=RetryPolicy("supervisor-restart", max_attempts=1,
+                            base_delay_s=0.2, max_delay_s=1.0,
+                            seed=0))
+    try:
+        rc = sup.run()
+    finally:
+        # flush even when the budget exhausts — the supervisor JSONL
+        # is the postmortem for exactly that case
+        if telemetry is not None:
+            telemetry.close()
+    return rc, sup, telemetry.run_dir if telemetry is not None else None
+
+
+def _read_events(run_dir: str) -> list:
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl"),
+              encoding="utf-8") as f:
+        for ln in f:
+            if ln.strip():
+                out.append(json.loads(ln))
+    return out
+
+
+# ------------------------------------------------------------ scenarios
+
+def scenario_kill_resume(out: str, *, epochs: int = 2,
+                         kill_at_step: int = 5) -> dict:
+    """SIGKILL mid-epoch (1 process) -> supervisor relaunch ->
+    auto-resume -> final checkpoint bit-identical to an uninterrupted
+    run's."""
+    prefix = build_dataset(os.path.join(out, "data"))
+    oracle_dir = os.path.join(out, "ckpt_oracle")
+    chaos_dir = os.path.join(out, "ckpt_chaos")
+    t0 = time.time()
+    _run_plain(train_cmd(prefix, oracle_dir, epochs=epochs),
+               cpu_devices=1, timeout_s=600)
+
+    marker = os.path.join(out, "killed.once")
+    faults = _write_faults(os.path.join(out, "faults.json"), {
+        "train/kill": {"action": "kill", "at": kill_at_step,
+                       "marker": marker}})
+    cmd = train_cmd(prefix, chaos_dir, epochs=epochs) \
+        + ["--auto_resume", "--faults", faults]
+    rc, sup, run_dir = _supervised(
+        cmd, out=out, ckpt_dir=chaos_dir,
+        telemetry_dir=os.path.join(out, "tele"))
+
+    o_step, o_state = _latest_state(oracle_dir)
+    c_step, c_state = _latest_state(chaos_dir)
+    diffs = trees_bit_equal(o_state, c_state)
+    result = {
+        "scenario": "kill_resume",
+        "kill_fired": os.path.exists(marker),
+        "supervisor_rc": rc,
+        "restarts": sup.restarts,
+        "resumed_from_step": sup.resumed_from_step,
+        "oracle_step": o_step, "chaos_step": c_step,
+        "param_diffs": diffs,
+        "wall_s": round(time.time() - t0, 1),
+        "telemetry_run_dir": run_dir,
+    }
+    result["ok"] = (result["kill_fired"] and rc == 0
+                    and sup.restarts == 1 and o_step == c_step
+                    and not diffs)
+    return result
+
+
+def scenario_kill_resume_2proc(out: str, *, epochs: int = 3,
+                               kill_at_step: int = 4) -> dict:
+    """The same parity contract through a REAL 2-process Gloo cohort:
+    worker 1 is SIGKILLed mid-epoch; the supervisor reaps the
+    surviving peer and relaunches the cohort coherently on a fresh
+    port."""
+    prefix = build_dataset(os.path.join(out, "data"))
+    oracle_dir = os.path.join(out, "ckpt_oracle")
+    chaos_dir = os.path.join(out, "ckpt_chaos")
+    t0 = time.time()
+    # the oracle is ALSO a 2-process supervised run: identical
+    # topology, the only difference is the injected fault. The Gloo
+    # loopback transport race can restart the ORACLE too (its child
+    # has --auto_resume appended just like any supervised run) — that
+    # is fine precisely BECAUSE resume is bit-exact, which is the
+    # property under test; oracle restarts are recorded, not rejected.
+    rc_o, sup_o, _ = _supervised(
+        train_cmd(prefix, oracle_dir, epochs=epochs)
+        + ["--auto_resume"],
+        out=os.path.join(out, "oracle"), num_procs=2, cpu_devices=2,
+        ckpt_dir=oracle_dir)
+    if rc_o != 0:
+        return {"scenario": "kill_resume_2proc", "ok": False,
+                "error": f"oracle cohort failed (rc {rc_o}, "
+                         f"restarts {sup_o.restarts})"}
+
+    marker = os.path.join(out, "killed.once")
+    faults = _write_faults(os.path.join(out, "faults.json"), {
+        "train/kill": {"action": "kill", "at": kill_at_step,
+                       "process": 1, "marker": marker}})
+    cmd = train_cmd(prefix, chaos_dir, epochs=epochs) \
+        + ["--auto_resume", "--faults", faults]
+    rc, sup, run_dir = _supervised(
+        cmd, out=os.path.join(out, "chaos"), num_procs=2,
+        cpu_devices=2, ckpt_dir=chaos_dir,
+        telemetry_dir=os.path.join(out, "tele"))
+
+    o_step, o_state = _latest_state(oracle_dir)
+    c_step, c_state = _latest_state(chaos_dir)
+    diffs = trees_bit_equal(o_state, c_state)
+    result = {
+        "scenario": "kill_resume_2proc",
+        "kill_fired": os.path.exists(marker),
+        "supervisor_rc": rc,
+        "oracle_restarts": sup_o.restarts,
+        "restarts": sup.restarts,
+        "resumed_from_step": sup.resumed_from_step,
+        "oracle_step": o_step, "chaos_step": c_step,
+        "param_diffs": diffs,
+        "wall_s": round(time.time() - t0, 1),
+        "telemetry_run_dir": run_dir,
+    }
+    result["ok"] = (result["kill_fired"] and rc == 0
+                    and sup.restarts >= 1 and o_step == c_step
+                    and not diffs)
+    return result
+
+
+def _flip_byte_in_largest_blob(step_dir: str) -> str:
+    """Flip one byte mid-file in the largest file of the committed
+    state tree — the bit-rot the checksums exist to catch."""
+    state = os.path.join(step_dir, "state")
+    largest, size = None, -1
+    for base, _dirs, files in os.walk(state):
+        for name in files:
+            p = os.path.join(base, name)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    assert largest is not None and size > 0
+    with open(largest, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return largest
+
+
+def scenario_corrupt_checkpoint(out: str) -> dict:
+    """Bit-flip a leaf blob in the latest committed step: verified
+    restore detects it, the supervisor quarantines the step dir, emits
+    an `alert` event, and training resumes from the prior committed
+    step."""
+    from code2vec_tpu.training import checkpoint as ckpt
+    prefix = build_dataset(os.path.join(out, "data"))
+    ckpt_dir = os.path.join(out, "ckpt")
+    t0 = time.time()
+    # 2 epochs -> two committed, checksummed steps (3 and 6)
+    _run_plain(train_cmd(prefix, ckpt_dir, epochs=2),
+               cpu_devices=1, timeout_s=600)
+    steps = sorted(s for s, _ in ckpt._step_dirs(ckpt_dir))
+    assert len(steps) == 2, steps
+    flipped = _flip_byte_in_largest_blob(
+        os.path.join(ckpt_dir, f"step_{steps[-1]}"))
+
+    # resume for a 3rd epoch: the supervisor must fall back to steps[0]
+    cmd = train_cmd(prefix, ckpt_dir, epochs=3) + ["--auto_resume"]
+    rc, sup, run_dir = _supervised(
+        cmd, out=out, ckpt_dir=ckpt_dir,
+        telemetry_dir=os.path.join(out, "tele"))
+
+    quarantined = os.path.join(ckpt_dir, ckpt.QUARANTINE_DIRNAME,
+                               f"step_{steps[-1]}")
+    alerts = [e for e in _read_events(run_dir)
+              if e.get("kind") == "alert"
+              and e.get("rule") == "checkpoint_quarantined"
+              and e.get("transition") == "firing"] if run_dir else []
+    final = ckpt.latest_step(ckpt_dir)
+    result = {
+        "scenario": "corrupt_checkpoint",
+        "flipped_file": os.path.relpath(flipped, out),
+        "supervisor_rc": rc,
+        "restarts": sup.restarts,
+        "resumed_from_step": sup.resumed_from_step,
+        "quarantined": sup.quarantined,
+        "quarantine_dir_exists": os.path.isdir(quarantined),
+        "alert_events": len(alerts),
+        "final_step": final,
+        "wall_s": round(time.time() - t0, 1),
+        "telemetry_run_dir": run_dir,
+    }
+    result["ok"] = (rc == 0 and result["quarantine_dir_exists"]
+                    and sup.resumed_from_step == steps[0]
+                    and len(alerts) == 1
+                    and final is not None and final > steps[-1])
+    return result
+
+
+SCENARIOS = {
+    "kill_resume": scenario_kill_resume,
+    "kill_resume_2proc": scenario_kill_resume_2proc,
+    "corrupt_checkpoint": scenario_corrupt_checkpoint,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic chaos scenarios over the real "
+                    "supervisor + failpoint registry")
+    ap.add_argument("scenario", nargs="?", choices=sorted(SCENARIOS),
+                    help="which contract to exercise")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--out", default=None,
+                    help="work dir (default: a fresh temp dir)")
+    args = ap.parse_args(argv)
+
+    if args.list or not args.scenario:
+        for name, fn in sorted(SCENARIOS.items()):
+            print(f"{name}: {' '.join((fn.__doc__ or '').split())}")
+        return 0
+
+    out = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.scenario}_")
+    os.makedirs(out, exist_ok=True)
+    result = SCENARIOS[args.scenario](out)
+    print(json.dumps(result, indent=1, default=str))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,10 +36,13 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
 # training/ added with the async checkpoint writer — ISSUE 5; ops/
 # with the fused sparse-update kernel — ISSUE 8; parallel/ with the
 # multi-host burndown — ISSUE 9: the distribution layer ships
-# lint-clean, fetch_global is a sanctioned seam not a suppression)
+# lint-clean, fetch_global is a sanctioned seam not a suppression;
+# resilience/ with the fault/retry layer — ISSUE 10: the subsystem
+# whose whole job is not losing errors may never grandfather one)
 NO_BASELINE_PREFIXES = ("code2vec_tpu/serving/", "code2vec_tpu/obs/",
                         "code2vec_tpu/training/", "code2vec_tpu/ops/",
-                        "code2vec_tpu/parallel/")
+                        "code2vec_tpu/parallel/",
+                        "code2vec_tpu/resilience/")
 
 
 def _entry(f: Finding) -> Dict[str, str]:

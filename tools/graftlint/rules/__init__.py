@@ -10,4 +10,5 @@ Adding a rule (README "Static analysis" has the user-facing steps):
 """
 
 from tools.graftlint.rules import (config_drift, host_sync,  # noqa: F401
-                                   lock_discipline, retrace, test_markers)
+                                   lock_discipline, retrace,
+                                   swallowed_error, test_markers)

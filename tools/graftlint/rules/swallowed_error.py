@@ -1,0 +1,139 @@
+"""swallowed-error: a broad except that does NOTHING erases the only
+evidence a failure happened.
+
+The resilience layer (ISSUE 10) makes errors load-bearing: retries
+classify them, the supervisor restarts on them, sticky errors surface
+them at barriers. A `except Exception: pass` (or bare `except:` /
+`except BaseException:` with an empty body, or except-and-`continue`)
+deletes that signal — the run limps on and the postmortem finds
+nothing. This rule flags exactly the DO-NOTHING shape:
+
+  - the handler catches broadly: bare `except:`, `Exception`,
+    `BaseException` (directly or inside a tuple);
+  - AND its body consists solely of `pass` / `continue` / a bare
+    constant expression (docstring, `...`) — no raise, no logging, no
+    fallback assignment, no error stash.
+
+Anything that DOES something with the error is out of scope by
+construction: `self._error = e` (the sticky-error stash), `return
+fallback`, a log call, a re-raise — none of those bodies are
+do-nothing. Narrow excepts (`except queue.Full: continue`) are fine:
+naming the exception IS the documentation.
+
+Sanctioned teardown paths: handlers inside functions named like
+teardown (`close`, `stop`, `shutdown`, `teardown`, `__exit__`,
+`__del__`, `drain*`/`_drain*`, `cleanup`/`_cleanup`) or anywhere under
+a `finally:` block — best-effort cleanup legitimately swallows, and the
+original error (if any) is already in flight there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graftlint.core import (FileContext, Finding, Rule,
+                                  dotted_name, register)
+
+RULE = "swallowed-error"
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+_TEARDOWN_NAMES = frozenset({"close", "stop", "shutdown", "teardown",
+                             "__exit__", "__del__", "cleanup",
+                             "_cleanup"})
+
+
+def _is_broad(exc_type) -> bool:
+    """Does this handler's type catch Exception or wider?"""
+    if exc_type is None:
+        return True  # bare except:
+    if isinstance(exc_type, ast.Tuple):
+        return any(_is_broad(e) for e in exc_type.elts)
+    name = dotted_name(exc_type)
+    return name in _BROAD or name in {f"builtins.{b}" for b in _BROAD}
+
+
+def _is_do_nothing(body) -> bool:
+    """True when the handler body neither acts on nor records the
+    error: only pass/continue/bare-constant statements."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        return False
+    return True
+
+
+def _teardown_func(name: str) -> bool:
+    return (name in _TEARDOWN_NAMES or name.startswith("drain")
+            or name.startswith("_drain"))
+
+
+class _Walker:
+    """Tree walk tracking the enclosing function name and whether the
+    node executes inside a `finally:` block (both sanction a swallow)."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def visit(self, node: ast.AST, func: str = "<module>",
+              in_finally: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a def INSIDE a finally block is a fresh scope — its body
+            # runs whenever it is called, not as teardown
+            func, in_finally = node.name, False
+        elif isinstance(node, ast.Try):
+            for stmt in node.body + node.orelse:
+                self.visit(stmt, func, in_finally)
+            for handler in node.handlers:
+                self._check_handler(handler, func, in_finally)
+                for stmt in handler.body:
+                    self.visit(stmt, func, in_finally)
+            for stmt in node.finalbody:
+                self.visit(stmt, func, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, func, in_finally)
+
+    def _check_handler(self, handler: ast.ExceptHandler, func: str,
+                       in_finally: bool) -> None:
+        if not _is_broad(handler.type):
+            return
+        if not _is_do_nothing(handler.body):
+            return
+        if in_finally or _teardown_func(func):
+            return
+        what = "bare except:" if handler.type is None else \
+            f"except {_render(handler.type)}:"
+        self.findings.append(Finding(
+            rule=RULE, path=self.ctx.rel, line=handler.lineno,
+            symbol=func,
+            message=(f"{what} swallows the error with no log, "
+                     "re-raise, or fallback — the failure signal the "
+                     "resilience layer routes on is erased; log it, "
+                     "narrow the except, stash it, or move the "
+                     "swallow into a sanctioned teardown path")))
+
+
+def _render(exc_type) -> str:
+    if isinstance(exc_type, ast.Tuple):
+        return "(" + ", ".join(_render(e) for e in exc_type.elts) + ")"
+    return dotted_name(exc_type) or "<?>"
+
+
+@register
+class SwallowedErrorRule(Rule):
+    name = RULE
+    description = ("broad `except Exception/BaseException/bare` whose "
+                   "body only passes/continues — the error is erased "
+                   "without log, re-raise, or fallback (teardown "
+                   "paths and finally blocks sanctioned)")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        w = _Walker(ctx)
+        w.visit(ctx.tree)
+        return w.findings

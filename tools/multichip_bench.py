@@ -443,42 +443,47 @@ def main(argv=None) -> int:
     if args.sparse:
         forward.append("--sparse")
 
+    # Gloo over loopback TCP intermittently dies mid-run with
+    # `EnforceNotMet: op.preamble.length <= op.nbytes` (a transport
+    # race the compat docstring documents; the crashed worker takes
+    # its peer down with it). One rep's crash is transient infra, not
+    # a measurement — retry the whole PAIR on a fresh port (each
+    # attempt's _spawn_leg picks one) so the elected ratio never mixes
+    # legs from different attempts. TimeoutExpired is the same failure
+    # seen from the other side: the crashed worker's peer can sit
+    # inside a collective until the (CPU-widened) heartbeat tolerance
+    # expires, so the parent hits its communicate() wall first. The
+    # retry itself is the shared resilience policy (ISSUE 10) — the
+    # hand-rolled attempt loop this file used to carry is gone.
+    sys.path.insert(0, _REPO)
+    from code2vec_tpu.resilience import retry as retry_mod
+    pair_retry = retry_mod.transient_distributed(
+        "multichip-rep", base_delay_s=0.2,
+        log=lambda m: print(m, file=sys.stderr))
+
     import tempfile
     pairs = []
     rep_retries = 0
     with tempfile.TemporaryDirectory(prefix="multichip_") as tmp:
         t0 = time.time()
         for rep in range(max(1, args.reps)):
-            # Gloo over loopback TCP intermittently dies mid-run with
-            # `EnforceNotMet: op.preamble.length <= op.nbytes` (a
-            # transport race the compat docstring documents; the
-            # crashed worker takes its peer down with it). One rep's
-            # crash is transient infra, not a measurement — retry the
-            # whole PAIR on a fresh port so the elected ratio never
-            # mixes legs from different attempts. TimeoutExpired is
-            # the same failure seen from the other side: the crashed
-            # worker's peer can sit inside a collective until the
-            # (CPU-widened) heartbeat tolerance expires, so the parent
-            # hits its communicate() wall first.
-            for attempt in range(3):
-                try:
-                    base = _spawn_leg(
-                        1, args.devices_per_proc * args.procs,
-                        os.path.join(tmp, f"base{rep}_{attempt}"),
-                        forward, args.telemetry_dir, args.timeout_s)
-                    multi = _spawn_leg(
-                        args.procs, args.devices_per_proc,
-                        os.path.join(tmp, f"multi{rep}_{attempt}"),
-                        forward, args.telemetry_dir, args.timeout_s)
-                    break
-                except (RuntimeError, subprocess.TimeoutExpired) as e:
-                    rep_retries += 1
-                    if attempt == 2:
-                        raise
-                    print(f"rep {rep} attempt {attempt} failed "
-                          f"(transient distributed-runtime error: "
-                          f"{str(e).splitlines()[0][:120]}); "
-                          "retrying on a fresh port", file=sys.stderr)
+            calls = {"n": 0}
+
+            def run_pair():
+                calls["n"] += 1
+                tag = f"{rep}_{calls['n']}"
+                base = _spawn_leg(
+                    1, args.devices_per_proc * args.procs,
+                    os.path.join(tmp, f"base{tag}"),
+                    forward, args.telemetry_dir, args.timeout_s)
+                multi = _spawn_leg(
+                    args.procs, args.devices_per_proc,
+                    os.path.join(tmp, f"multi{tag}"),
+                    forward, args.telemetry_dir, args.timeout_s)
+                return base, multi
+
+            base, multi = pair_retry.call(run_pair)
+            rep_retries += calls["n"] - 1
             pairs.append((base, multi))
             print(f"rep {rep}: base p50 "
                   f"{base['ms_per_step_p50']:.0f} ms, multi p50 "

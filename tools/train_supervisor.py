@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Restart supervisor CLI (ISSUE 10): wrap a training command with a
+bounded restart budget, verified auto-resume, and coherent cohort
+relaunch.
+
+Usage (repo root):
+
+  # single process, up to 3 restarts, resume from --save's checkpoints
+  python tools/train_supervisor.py --max_restarts 3 -- \
+      python code2vec.py --data d/ds --save ckpt --lr_schedule constant
+
+  # a 2-process Gloo cohort on the CPU harness (4 virtual devices per
+  # worker); the supervisor appends the --dist_* flags itself and
+  # relaunches the WHOLE cohort on a fresh port when any member dies
+  python tools/train_supervisor.py --procs 2 --cpu_devices 4 -- \
+      python code2vec.py --data d/ds --save ckpt --lr_schedule constant
+
+Everything after `--` is the child command. The supervisor:
+
+  - appends `--auto_resume` when the child has `--save` but forgot the
+    flag (a supervised run that restarts from scratch would defeat the
+    point — announced, not silent);
+  - verifies the checkpoint dir before EVERY launch, quarantining
+    corrupt step dirs (training/checkpoint.verify_and_resolve) so the
+    child resumes from the last VERIFIED committed step;
+  - escalates through the alert engine (`--telemetry_dir` makes the
+    `alert` / `supervisor_*` events durable JSONL).
+
+Exit codes: 0 = the supervised run completed; 3 = restart budget
+exhausted; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_save_dir(child_cmd) -> str | None:
+    for i, tok in enumerate(child_cmd):
+        if tok == "--save" and i + 1 < len(child_cmd):
+            return child_cmd[i + 1]
+        if tok.startswith("--save="):
+            return tok.split("=", 1)[1]
+    return None
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, _REPO)
+    ap = argparse.ArgumentParser(
+        description="restart supervisor: <flags> -- <child command>")
+    ap.add_argument("--max_restarts", type=int, default=3,
+                    help="cohort relaunches before giving up (page "
+                         "alert + exit 3)")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="cohort size; >1 appends --dist_* flags per "
+                         "member on a fresh port per attempt")
+    ap.add_argument("--cpu_devices", type=int, default=None,
+                    help="pin this many virtual CPU devices per child "
+                         "(the Gloo CPU harness) via the spawn env")
+    ap.add_argument("--peer_grace_s", type=float, default=15.0,
+                    help="after one member dies, how long the rest get "
+                         "to exit on their own before SIGKILL")
+    ap.add_argument("--attempt_timeout_s", type=float, default=None,
+                    help="wall limit per attempt (unset = none)")
+    ap.add_argument("--backoff_base_s", type=float, default=1.0,
+                    help="restart backoff base (jittered exponential, "
+                         "the shared resilience/retry math)")
+    ap.add_argument("--telemetry_dir", default=None,
+                    help="supervisor run telemetry (supervisor_* + "
+                         "alert JSONL events)")
+    ap.add_argument("--out_dir", default=None,
+                    help="per-attempt child logs "
+                         "(attempt<k>.proc<i>.log); default: inherit "
+                         "stdio")
+    ap.add_argument("child", nargs=argparse.REMAINDER,
+                    help="-- <child command>")
+    args = ap.parse_args(argv)
+
+    child = list(args.child)
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        ap.error("no child command given (put it after `--`)")
+
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.resilience.retry import RetryPolicy
+    from code2vec_tpu.training.supervisor import (RestartBudgetExceeded,
+                                                  Supervisor,
+                                                  build_cli_spawn)
+
+    def log(msg: str) -> None:
+        print(f"[train_supervisor] {msg}", flush=True)
+
+    save_dir = _child_save_dir(child)
+    if save_dir and "--auto_resume" not in child:
+        log("child has --save but no --auto_resume; appending it "
+            "(a supervised restart must resume, not retrain)")
+        child.append("--auto_resume")
+
+    telemetry = Telemetry.create(args.telemetry_dir,
+                                 component="supervisor", log=log) \
+        if args.telemetry_dir else None
+
+    sup = Supervisor(
+        build_cli_spawn(child, num_procs=args.procs,
+                        out_dir=args.out_dir,
+                        cpu_devices=args.cpu_devices, log=log),
+        num_procs=args.procs, max_restarts=args.max_restarts,
+        ckpt_dir=save_dir, telemetry=telemetry, log=log,
+        peer_grace_s=args.peer_grace_s,
+        attempt_timeout_s=args.attempt_timeout_s,
+        backoff=RetryPolicy("supervisor-restart", max_attempts=1,
+                            base_delay_s=args.backoff_base_s,
+                            max_delay_s=60.0))
+    try:
+        rc = sup.run()
+    except RestartBudgetExceeded as e:
+        log(str(e))
+        rc = 3
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
