@@ -531,7 +531,11 @@ class Code2VecModel(Code2VecModelBase):
                     epoch_end_work = True
                 if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                     eval_span = telemetry.span("train/eval_ms")
-                    results = self.evaluate()
+                    try:
+                        results = self.evaluate()
+                    except BaseException:
+                        eval_span.cancel()  # dead eval: drop, don't leak
+                        raise
                     eval_ms = eval_span.stop()
                     self.log(f"epoch {epoch} evaluation: {results}")
                     scalars.write(self.step_num, {
@@ -644,10 +648,16 @@ class Code2VecModel(Code2VecModelBase):
         device. Timed as `serve/parse_ms` (the pre-split `encode_ms`
         covered parse + pad; the phases now report separately)."""
         parse_span = self.telemetry.span("serve/parse_ms")
-        lines = [ln for ln in predict_data_lines if ln.strip()]
-        labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
-            lines, self.vocabs, self.config.MAX_CONTEXTS,
-            keep_strings=True)
+        try:
+            lines = [ln for ln in predict_data_lines if ln.strip()]
+            labels, src, pth, dst, mask, tstr, cstr = parse_c2v_rows(
+                lines, self.vocabs, self.config.MAX_CONTEXTS,
+                keep_strings=True)
+        except BaseException:
+            # a malformed row must not leak the span, and a dead parse
+            # must not land in the parse_ms histogram
+            parse_span.cancel()
+            raise
         parse_span.stop()
         return PreparedRows(labels, src, pth, dst, mask, tstr, cstr)
 
@@ -711,15 +721,25 @@ class Code2VecModel(Code2VecModelBase):
         encode_span = self.telemetry.span("serve/encode_ms")
         t_encode = self.tracer.start_span("serve/encode", n=n) \
             if tracing else None
-        padded_n = self.predict_bucket_size(n)
-        weights = np.zeros((padded_n,), dtype=np.float32)
-        weights[:n] = 1.0
-        labels, src, pth, dst, mask = _pad_batch(
-            (prepared.labels, prepared.src, prepared.pth, prepared.dst,
-             prepared.mask), padded_n)
-        batch = (labels, src, pth, dst, mask, weights)
-        if self.mesh is not None:
-            batch = shard_batch(self.mesh, batch, process_local=False)
+        try:
+            padded_n = self.predict_bucket_size(n)
+            weights = np.zeros((padded_n,), dtype=np.float32)
+            weights[:n] = 1.0
+            labels, src, pth, dst, mask = _pad_batch(
+                (prepared.labels, prepared.src, prepared.pth,
+                 prepared.dst, prepared.mask), padded_n)
+            batch = (labels, src, pth, dst, mask, weights)
+            if self.mesh is not None:
+                batch = shard_batch(self.mesh, batch,
+                                    process_local=False)
+        except BaseException:
+            # close on the error path too: an un-ended trace span sits
+            # in the live-span table forever, and the batcher thread
+            # serves many more requests after this one dies
+            if t_encode is not None:
+                t_encode.end()
+            encode_span.cancel()
+            raise
         if t_encode is not None:
             t_encode.end()
         encode_span.stop()
@@ -729,12 +749,18 @@ class Code2VecModel(Code2VecModelBase):
         t_device = self.tracer.start_span("serve/device",
                                           padded_n=padded_n) \
             if tracing else None
-        topk_ids, topk_probs, attn, code = self._predict_step(
-            self.params, batch)
-        topk_ids = fetch_global(topk_ids)[:n]
-        topk_probs = fetch_global(topk_probs)[:n]
-        attn = fetch_global(attn)[:n]
-        code = fetch_global(code)[:n]
+        try:
+            topk_ids, topk_probs, attn, code = self._predict_step(
+                self.params, batch)
+            topk_ids = fetch_global(topk_ids)[:n]
+            topk_probs = fetch_global(topk_probs)[:n]
+            attn = fetch_global(attn)[:n]
+            code = fetch_global(code)[:n]
+        except BaseException:
+            if t_device is not None:
+                t_device.end()
+            predict_span.cancel()
+            raise
         if t_device is not None:
             t_device.end()
         predict_span.stop()
@@ -826,7 +852,6 @@ class Code2VecModel(Code2VecModelBase):
                  # provenance only (no structural effect on restore)
                  "adv_rename_prob": self.config.ADV_RENAME_PROB,
                  "adv_rename_mode": self.config.ADV_RENAME_MODE}
-        blocked_span = self.telemetry.span("train/save_blocked_ms")
         # trace (--trace): the save's blocked window LINKS the step that
         # triggered it (the per-step trace the recorder keeps current),
         # and the writer thread parents its train/save_write span to
@@ -840,33 +865,47 @@ class Code2VecModel(Code2VecModelBase):
                 is_async=bool(self.config.ASYNC_CHECKPOINT))
             if last is not None:
                 trace_span.links.append(last)
-        if self.config.ASYNC_CHECKPOINT:
-            writer = self._checkpoint_writer()
-            writer.submit(path, state, self.step_num, self.vocabs,
-                          self.dims, extra_manifest=extra,
-                          max_to_keep=self.config.MAX_TO_KEEP,
-                          telemetry=self.telemetry,
-                          tracer=self.tracer if trace_span is not None
-                          else None,
-                          trace_ctx=trace_span.context()
-                          if trace_span is not None else None)
-            if block:
-                writer.wait()
-            blocked_ms = blocked_span.stop()
-            self.log(f"queued checkpoint step {self.step_num} -> {path} "
-                     f"(loop blocked {blocked_ms:.1f} ms)")
-        else:
-            ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
-                                 self.dims, extra_manifest=extra,
-                                 max_to_keep=self.config.MAX_TO_KEEP)
-            blocked_ms = blocked_span.stop()
-            # the sync save IS its own writer: total == blocked, and the
-            # commit event keeps telemetry_report's boundary table
-            # mode-agnostic
-            self.telemetry.record_ms("train/save_total_ms", blocked_ms)
-            self.telemetry.event("save_committed", step=self.step_num,
-                                 total_ms=round(blocked_ms, 3))
-            self.log(f"saved checkpoint step {self.step_num} -> {path}")
+        blocked_span = self.telemetry.span("train/save_blocked_ms")
+        try:
+            if self.config.ASYNC_CHECKPOINT:
+                writer = self._checkpoint_writer()
+                writer.submit(path, state, self.step_num, self.vocabs,
+                              self.dims, extra_manifest=extra,
+                              max_to_keep=self.config.MAX_TO_KEEP,
+                              telemetry=self.telemetry,
+                              tracer=self.tracer
+                              if trace_span is not None else None,
+                              trace_ctx=trace_span.context()
+                              if trace_span is not None else None)
+                if block:
+                    writer.wait()
+                blocked_ms = blocked_span.stop()
+                self.log(f"queued checkpoint step {self.step_num} -> "
+                         f"{path} (loop blocked {blocked_ms:.1f} ms)")
+            else:
+                ckpt.save_checkpoint(path, state, self.step_num,
+                                     self.vocabs, self.dims,
+                                     extra_manifest=extra,
+                                     max_to_keep=self.config.MAX_TO_KEEP)
+                blocked_ms = blocked_span.stop()
+                # the sync save IS its own writer: total == blocked, and
+                # the commit event keeps telemetry_report's boundary
+                # table mode-agnostic
+                self.telemetry.record_ms("train/save_total_ms",
+                                         blocked_ms)
+                self.telemetry.event("save_committed",
+                                     step=self.step_num,
+                                     total_ms=round(blocked_ms, 3))
+                self.log(f"saved checkpoint step {self.step_num} -> "
+                         f"{path}")
+        except BaseException:
+            # a failed submit/save (sticky writer error, dead disk)
+            # must not leak the blocked span or leave the save trace
+            # open in the live-span table
+            blocked_span.cancel()
+            if trace_span is not None:
+                trace_span.end(outcome="error")
+            raise
         if trace_span is not None:
             trace_span.end(blocked_ms=round(blocked_ms, 3))
         self.telemetry.event("save", step=self.step_num,

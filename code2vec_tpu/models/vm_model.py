@@ -302,7 +302,11 @@ class VarMisuseModel:
                     epoch_end_work = True
                 if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                     eval_span = telemetry.span("train/eval_ms")
-                    results = self.evaluate()
+                    try:
+                        results = self.evaluate()
+                    except BaseException:
+                        eval_span.cancel()  # dead eval: drop, don't leak
+                        raise
                     eval_ms = eval_span.stop()
                     self.log(f"vm epoch {epoch}: {results}")
                     telemetry.event("eval", epoch=epoch, step=self.step_num,
@@ -407,7 +411,6 @@ class VarMisuseModel:
                  "trust_ratio": self.config.TRUST_RATIO,
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS}
-        blocked_span = self.telemetry.span("train/save_blocked_ms")
         trace_span = None
         if self.tracer.enabled:
             rec = getattr(self, "_trace_recorder", None)
@@ -417,34 +420,48 @@ class VarMisuseModel:
                 is_async=bool(self.config.ASYNC_CHECKPOINT))
             if last is not None:
                 trace_span.links.append(last)
-        if self.config.ASYNC_CHECKPOINT:
-            if self._ckpt_writer is None:
-                self._ckpt_writer = ckpt.AsyncCheckpointWriter(
-                    log=self.log,
-                    heartbeat=getattr(self, "_ckpt_heartbeat", None))
-            self._ckpt_writer.submit(
-                path, state, self.step_num, self.vocabs, self.dims,
-                extra_manifest=extra,
-                max_to_keep=self.config.MAX_TO_KEEP,
-                telemetry=self.telemetry,
-                tracer=self.tracer if trace_span is not None else None,
-                trace_ctx=trace_span.context()
-                if trace_span is not None else None)
-            if block:
-                self._ckpt_writer.wait()
-            blocked_ms = blocked_span.stop()
-            self.log(f"queued varmisuse checkpoint step {self.step_num} "
-                     f"-> {path} (loop blocked {blocked_ms:.1f} ms)")
-        else:
-            ckpt.save_checkpoint(path, state, self.step_num, self.vocabs,
-                                 self.dims, extra_manifest=extra,
-                                 max_to_keep=self.config.MAX_TO_KEEP)
-            blocked_ms = blocked_span.stop()
-            self.telemetry.record_ms("train/save_total_ms", blocked_ms)
-            self.telemetry.event("save_committed", step=self.step_num,
-                                 total_ms=round(blocked_ms, 3))
-            self.log(f"saved varmisuse checkpoint step {self.step_num} "
-                     f"-> {path}")
+        blocked_span = self.telemetry.span("train/save_blocked_ms")
+        try:
+            if self.config.ASYNC_CHECKPOINT:
+                if self._ckpt_writer is None:
+                    self._ckpt_writer = ckpt.AsyncCheckpointWriter(
+                        log=self.log,
+                        heartbeat=getattr(self, "_ckpt_heartbeat", None))
+                self._ckpt_writer.submit(
+                    path, state, self.step_num, self.vocabs, self.dims,
+                    extra_manifest=extra,
+                    max_to_keep=self.config.MAX_TO_KEEP,
+                    telemetry=self.telemetry,
+                    tracer=self.tracer if trace_span is not None
+                    else None,
+                    trace_ctx=trace_span.context()
+                    if trace_span is not None else None)
+                if block:
+                    self._ckpt_writer.wait()
+                blocked_ms = blocked_span.stop()
+                self.log(f"queued varmisuse checkpoint step "
+                         f"{self.step_num} -> {path} "
+                         f"(loop blocked {blocked_ms:.1f} ms)")
+            else:
+                ckpt.save_checkpoint(path, state, self.step_num,
+                                     self.vocabs, self.dims,
+                                     extra_manifest=extra,
+                                     max_to_keep=self.config.MAX_TO_KEEP)
+                blocked_ms = blocked_span.stop()
+                self.telemetry.record_ms("train/save_total_ms",
+                                         blocked_ms)
+                self.telemetry.event("save_committed",
+                                     step=self.step_num,
+                                     total_ms=round(blocked_ms, 3))
+                self.log(f"saved varmisuse checkpoint step "
+                         f"{self.step_num} -> {path}")
+        except BaseException:
+            # a failed submit/save must not leak the blocked span or
+            # leave the save trace open in the live-span table
+            blocked_span.cancel()
+            if trace_span is not None:
+                trace_span.end(outcome="error")
+            raise
         if trace_span is not None:
             trace_span.end(blocked_ms=round(blocked_ms, 3))
         self.telemetry.event("save", step=self.step_num,

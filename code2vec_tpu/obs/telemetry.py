@@ -151,11 +151,20 @@ class _Span:
         self._t0 = time.perf_counter()
 
     def stop(self, sync=None) -> float:
+        if self._tele is None:  # cancelled: defensively closed already
+            return 0.0
         if sync is not None:
             device_sync(sync)
         ms = (time.perf_counter() - self._t0) * 1e3
         self._tele.record_ms(self._name, ms)
         return ms
+
+    def cancel(self) -> None:
+        """Close WITHOUT recording — the error-path release (graftlint
+        resource-leak discipline): a request that died mid-span must
+        not leak the span, but its partial duration would pollute the
+        latency histogram, so it is dropped instead of stopped."""
+        self._tele = None
 
 
 class _NullSpan:
@@ -163,6 +172,9 @@ class _NullSpan:
 
     def stop(self, sync=None) -> float:
         return 0.0
+
+    def cancel(self) -> None:
+        pass
 
 
 _NULL_SPAN = _NullSpan()

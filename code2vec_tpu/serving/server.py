@@ -240,7 +240,13 @@ class PredictionServer:
         except BaseException:
             # close the trace on the error path too — an un-ended root
             # would sit in the live-span table forever (and pollute
-            # every watchdog stall dump with phantom requests)
+            # every watchdog stall dump with phantom requests); the
+            # telemetry spans cancel (a dead extract's partial ms
+            # would pollute the extract/request histograms), and
+            # request_span must close HERE — its ownership only
+            # transfers to predict_lines on the success path
+            span.cancel()
+            request_span.cancel()
             if root is not None:
                 ex_span.end()
                 root.end(outcome="error")
@@ -265,6 +271,9 @@ class PredictionServer:
         out a cold jit compile); None takes `--serve_deadline_ms`."""
         if not self._started:
             self.start()
+        # host-only filter BEFORE the spans open: nothing here belongs
+        # in request_ms, and the acquire-to-try window stays raise-free
+        lines = [ln for ln in lines if ln.strip()]
         request_span = (_request_span if _request_span is not None
                         else self.telemetry.span("serve/request_ms"))
         # request-scoped trace root: ONE trace id follows this request
@@ -274,8 +283,12 @@ class PredictionServer:
         if root is None and self.tracer.enabled:
             root = self.tracer.start_trace("serve/request",
                                            n_methods=len(lines))
-        lines = [ln for ln in lines if ln.strip()]
         if not lines:
+            # all-blank input never reaches the queue and emits no
+            # `request` event — cancel (not stop) so the request_ms
+            # histogram, serve/requests counter, and report stay in
+            # agreement about what counts as a request
+            request_span.cancel()
             if root is not None:
                 root.end(n_results=0)
             return []
@@ -283,130 +296,142 @@ class PredictionServer:
             deadline_ms = self.config.SERVE_DEADLINE_MS
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms and deadline_ms > 0 else None)
+        try:
+            # cache probe: hits never touch the queue (skipped entirely at
+            # capacity 0 — no key sorts, no counters, on the load path)
+            out: List[Optional[MethodPredictionResults]] = [None] * len(lines)
+            use_cache = self.cache.capacity > 0
+            keys: List = [None] * len(lines)
+            miss_idx: List[int] = []
+            if use_cache:
+                for i, ln in enumerate(lines):
+                    keys[i] = key = normalize_bag(ln)
+                    hit = self.cache.get(key)
+                    if hit is not None:
+                        out[i] = hit
+                        self.telemetry.count("serve/cache_hit")
+                    else:
+                        miss_idx.append(i)
+                        self.telemetry.count("serve/cache_miss")
+            else:
+                miss_idx = list(range(len(lines)))
 
-        # cache probe: hits never touch the queue (skipped entirely at
-        # capacity 0 — no key sorts, no counters, on the load path)
-        out: List[Optional[MethodPredictionResults]] = [None] * len(lines)
-        use_cache = self.cache.capacity > 0
-        keys: List = [None] * len(lines)
-        miss_idx: List[int] = []
-        if use_cache:
-            for i, ln in enumerate(lines):
-                keys[i] = key = normalize_bag(ln)
-                hit = self.cache.get(key)
-                if hit is not None:
-                    out[i] = hit
-                    self.telemetry.count("serve/cache_hit")
-                else:
-                    miss_idx.append(i)
-                    self.telemetry.count("serve/cache_miss")
-        else:
-            miss_idx = list(range(len(lines)))
-
-        if miss_idx:
-            # host parse on the CALLER's thread — the batcher only sees
-            # ready-to-pad rows; oversized requests chunk to max_batch
-            # so every flush stays inside the warmed buckets
-            parse_span = self.tracer.start_span(
-                "serve/parse", parent=root, n=len(miss_idx)) \
-                if root is not None else None
-            try:
-                prepared = self.model.prepare_predict_rows(
-                    [lines[i] for i in miss_idx])
-            except BaseException:
-                # malformed input: close the trace instead of leaking
-                # root/parse into the live-span table on every bad
-                # request a long-running server sees
-                if root is not None:
-                    parse_span.end()
-                    root.end(outcome="error")
-                raise
-            if parse_span is not None:
-                parse_span.end()
-            root_ctx = root.context() if root is not None else None
-            cap = self.batcher.max_batch
-            chunks = [prepared.slice(at, min(at + cap, prepared.n))
-                      for at in range(0, prepared.n, cap)]
-            reqs = []
-            for chunk in chunks:
-                req = PredictRequest(chunk, chunk.n, deadline=deadline,
-                                     trace_ctx=root_ctx)
-                if not self.batcher.submit(req):
-                    # shed the WHOLE request: resolve the sibling
-                    # chunks already queued so the batcher skips them
-                    # instead of computing results nobody will consume.
-                    # serve/shed counts CHUNKS (queue units) on every
-                    # shed path; loadgen's `shed` counts requests.
-                    overload = ServerOverloaded(
-                        "server shutting down"
-                        if not self.batcher.running else
-                        f"request queue full "
-                        f"(depth {self.batcher.queue_depth})")
-                    n_shed = 1  # the refused chunk
-                    for prev in reqs:
-                        if prev.fail(overload):
-                            n_shed += 1
-                    self.telemetry.count("serve/shed", n_shed)
+            if miss_idx:
+                # host parse on the CALLER's thread — the batcher only sees
+                # ready-to-pad rows; oversized requests chunk to max_batch
+                # so every flush stays inside the warmed buckets
+                parse_span = self.tracer.start_span(
+                    "serve/parse", parent=root, n=len(miss_idx)) \
+                    if root is not None else None
+                try:
+                    prepared = self.model.prepare_predict_rows(
+                        [lines[i] for i in miss_idx])
+                except BaseException:
+                    # malformed input: close the trace instead of leaking
+                    # root/parse into the live-span table on every bad
+                    # request a long-running server sees
                     if root is not None:
-                        root.end(outcome="shed")
-                    raise overload
-                reqs.append(req)
-            miss_results: List[MethodPredictionResults] = []
-            decode_span = None
-            try:
-                for chunk, req in zip(chunks, reqs):
-                    # wait past the deadline by one batch window so an
-                    # in-flight batch containing this request can still
-                    # land
-                    wait_s = None
-                    if deadline is not None:
-                        wait_s = max(0.0, deadline - time.monotonic()) \
-                            + self.batcher.timeout_s + 5.0
-                    if not req.wait(wait_s):
-                        if req.fail(ServerOverloaded(
-                                "request timed out")):
-                            # our fail won (vs a late batch result)
-                            self.telemetry.count("serve/shed")
-                    if req.error is not None:
-                        raise req.error
-                    # decode on the CALLER's thread: the batcher's
-                    # critical path stays device-only, decode
-                    # parallelizes across clients
-                    decode_span = self.tracer.start_span(
-                        "serve/decode", parent=root, n=chunk.n) \
-                        if root is not None else None
-                    miss_results.extend(self.model.decode_predictions(
-                        chunk, req.result))
-                    if decode_span is not None:
-                        decode_span.end()
-            except BaseException:
-                # resolve any still-pending sibling chunks so the
-                # batcher skips them (no device work for a dead waiter)
-                dead = ServerOverloaded("sibling chunk failed")
-                for r in reqs:
-                    r.fail(dead)
-                if root is not None:
-                    if decode_span is not None:
-                        decode_span.end()  # idempotent: safe if closed
-                    root.end(outcome="error")
-                raise
-            for i, res in zip(miss_idx, miss_results):
-                out[i] = res
-                if use_cache:
-                    self.cache.put(keys[i], res)
+                        parse_span.end()
+                        root.end(outcome="error")
+                    raise
+                if parse_span is not None:
+                    parse_span.end()
+                root_ctx = root.context() if root is not None else None
+                cap = self.batcher.max_batch
+                chunks = [prepared.slice(at, min(at + cap, prepared.n))
+                          for at in range(0, prepared.n, cap)]
+                reqs = []
+                for chunk in chunks:
+                    req = PredictRequest(chunk, chunk.n, deadline=deadline,
+                                         trace_ctx=root_ctx)
+                    if not self.batcher.submit(req):
+                        # shed the WHOLE request: resolve the sibling
+                        # chunks already queued so the batcher skips them
+                        # instead of computing results nobody will consume.
+                        # serve/shed counts CHUNKS (queue units) on every
+                        # shed path; loadgen's `shed` counts requests.
+                        overload = ServerOverloaded(
+                            "server shutting down"
+                            if not self.batcher.running else
+                            f"request queue full "
+                            f"(depth {self.batcher.queue_depth})")
+                        n_shed = 1  # the refused chunk
+                        for prev in reqs:
+                            if prev.fail(overload):
+                                n_shed += 1
+                        self.telemetry.count("serve/shed", n_shed)
+                        if root is not None:
+                            root.end(outcome="shed")
+                        raise overload
+                    reqs.append(req)
+                miss_results: List[MethodPredictionResults] = []
+                decode_span = None
+                try:
+                    for chunk, req in zip(chunks, reqs):
+                        # wait past the deadline by one batch window so an
+                        # in-flight batch containing this request can still
+                        # land
+                        wait_s = None
+                        if deadline is not None:
+                            wait_s = max(0.0, deadline - time.monotonic()) \
+                                + self.batcher.timeout_s + 5.0
+                        if not req.wait(wait_s):
+                            if req.fail(ServerOverloaded(
+                                    "request timed out")):
+                                # our fail won (vs a late batch result)
+                                self.telemetry.count("serve/shed")
+                        if req.error is not None:
+                            raise req.error
+                        # decode on the CALLER's thread: the batcher's
+                        # critical path stays device-only, decode
+                        # parallelizes across clients
+                        decode_span = self.tracer.start_span(
+                            "serve/decode", parent=root, n=chunk.n) \
+                            if root is not None else None
+                        miss_results.extend(self.model.decode_predictions(
+                            chunk, req.result))
+                        if decode_span is not None:
+                            decode_span.end()
+                except BaseException:
+                    # resolve any still-pending sibling chunks so the
+                    # batcher skips them (no device work for a dead waiter)
+                    dead = ServerOverloaded("sibling chunk failed")
+                    for r in reqs:
+                        r.fail(dead)
+                    if root is not None:
+                        if decode_span is not None:
+                            decode_span.end()  # idempotent: safe if closed
+                        root.end(outcome="error")
+                    raise
+                for i, res in zip(miss_idx, miss_results):
+                    out[i] = res
+                    if use_cache:
+                        self.cache.put(keys[i], res)
 
-        self.telemetry.count("serve/requests")
-        request_ms = request_span.stop()
-        if root is not None:
-            root.end(n_results=len(lines),
-                     n_cached=len(lines) - len(miss_idx))
-        fields = {"request_ms": round(request_ms, 3),
-                  "n_methods": len(lines),
-                  "n_cached": len(lines) - len(miss_idx)}
-        if extract_ms is not None:  # keep the PR-2 request-event shape
-            fields["extract_ms"] = round(extract_ms, 3)
-        self.telemetry.event("request", **fields)
-        return out  # fully populated: every index was a hit or a miss
+            self.telemetry.count("serve/requests")
+            request_ms = request_span.stop()
+            if root is not None:
+                root.end(n_results=len(lines),
+                         n_cached=len(lines) - len(miss_idx))
+            fields = {"request_ms": round(request_ms, 3),
+                      "n_methods": len(lines),
+                      "n_cached": len(lines) - len(miss_idx)}
+            if extract_ms is not None:  # keep the PR-2 request-event shape
+                fields["extract_ms"] = round(extract_ms, 3)
+            self.telemetry.event("request", **fields)
+            return out  # fully populated: every index was a hit or a miss
+        except BaseException:
+            # one outer fence for every error path (graftlint
+            # resource-leak): a failed request must not leak its
+            # telemetry span (cancel: a dead request's partial ms
+            # would pollute serve/request_ms) or leave the trace
+            # root in the live-span table; the specialized inner
+            # handlers already ended their spans - end() is
+            # idempotent, so this backstop double-closes safely
+            request_span.cancel()
+            if root is not None:
+                root.end(outcome="error")
+            raise
 
     # ---- batch execution (batcher thread) ----
     def _run_batch(self, requests: Sequence[PredictRequest]) -> List:

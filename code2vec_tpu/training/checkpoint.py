@@ -363,21 +363,27 @@ class AsyncCheckpointWriter:
         hours past a dead disk.
 
     `save_fn` is injectable for crash-safety tests (simulate a writer
-    killed before the `state` rename commits). `heartbeat` is the
-    obs.watchdog liveness hook (--watchdog_stall_s): busy at job
-    pickup, idle after commit — a write hung in orbax/disk I/O stops
-    beating and the watchdog dumps the writer thread's stack instead
-    of the run going silently wedged."""
+    killed before the `state` rename commits), and `clock` (default
+    `time.perf_counter`) is the duration timebase — the deflaked
+    timing tests (tests/test_async_checkpoint.py) drive a fake clock
+    through the injected save_fn instead of betting on wall-clock
+    ratios under CI contention. `heartbeat` is the obs.watchdog
+    liveness hook (--watchdog_stall_s): busy at job pickup, idle after
+    commit — a write hung in orbax/disk I/O stops beating and the
+    watchdog dumps the writer thread's stack instead of the run going
+    silently wedged."""
 
     def __init__(self, log: Optional[Callable[[str], None]] = None,
                  save_fn: Optional[Callable] = None,
-                 heartbeat=None):
+                 heartbeat=None,
+                 clock: Callable[[], float] = time.perf_counter):
         self._log = log or (lambda _m: None)
         # None -> module-level save_checkpoint, resolved at WRITE time
         # (tests monkeypatch the module function to inject slow disks
         # and torn writes)
         self._save_fn = save_fn
         self._heartbeat = heartbeat
+        self._clock = clock
         self._cond = threading.Condition()
         self._job: Optional[Dict[str, Any]] = None
         self._error: Optional[BaseException] = None
@@ -417,7 +423,6 @@ class AsyncCheckpointWriter:
                 "extra_manifest": extra_manifest,
                 "max_to_keep": max_to_keep, "telemetry": telemetry,
                 "tracer": tracer, "trace_ctx": trace_ctx,
-                "t_submit": time.perf_counter(),
             }
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -437,7 +442,7 @@ class AsyncCheckpointWriter:
             try:
                 if hb is not None:
                     hb.busy()  # deadline clock runs while writing
-                t0 = time.perf_counter()
+                t0 = self._clock()
                 tracer = job["tracer"]
                 t0_trace = tracer.clock() if tracer is not None else 0.0
                 save_fn = self._save_fn or save_checkpoint
@@ -445,7 +450,7 @@ class AsyncCheckpointWriter:
                         job["vocabs"], job["dims"],
                         extra_manifest=job["extra_manifest"],
                         max_to_keep=job["max_to_keep"])
-                total_ms = (time.perf_counter() - t0) * 1e3
+                total_ms = (self._clock() - t0) * 1e3
                 if tracer is not None:
                     # writer-side span, parented (cross-thread) to the
                     # loop's save span via the handed-off context

@@ -1,0 +1,89 @@
+"""donation-safety TRICKY FALSE POSITIVES: every function here is the
+sanctioned idiom — the rule must stay silent.
+
+Parsed, never imported — jax here is fake.
+"""
+
+import jax
+
+from fake_steps import make_train_step, snapshot_state  # noqa: F401
+
+
+def rebind_kills_taint(dims, optimizer, batches, rng):
+    """THE train-loop idiom: the statement that donates rebinds the
+    names, killing the taint on the same line."""
+    step = make_train_step(dims, optimizer)
+    params, opt_state = init(dims)
+    for batch in batches:
+        params, opt_state, loss = step(params, opt_state, batch, rng)
+        log_scalar(loss)          # loss is an output, not donated
+    return params                 # rebound every iteration: clean
+
+
+def snapshot_before_donation(step, params, opt_state, batch, rng):
+    """The checkpoint idiom (PR 5): snapshot_state results are fresh
+    buffers — the alias edge must NOT taint them."""
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    snap = snapshot_state({"params": params, "opt_state": opt_state})
+    params, opt_state, loss = jstep(params, opt_state, batch, rng)
+    submit_save(snap)             # FP-trap: snap is a sanctioned copy
+    return params, opt_state
+
+
+def explicit_copy_before_donation(step, params, batch, rng):
+    jstep = jax.jit(step, donate_argnums=(0,))
+    kept = jax.numpy.copy(params)
+    params = jstep(params, batch, rng)
+    return params, kept.mean()    # kept holds fresh buffers
+
+
+def read_before_donation_is_fine(step, params, opt_state, batch, rng):
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    norm = compute_norm(params)   # read BEFORE the donating call
+    params, opt_state, loss = jstep(params, opt_state, batch, rng)
+    return params, opt_state, norm
+
+
+def non_donating_eval_step(make_eval_step, dims, params, batches):
+    """Eval steps don't donate — post-call reads of params are fine."""
+    eval_step = make_eval_step(dims)      # unknown factory: no donation
+    total = 0.0
+    for batch in batches:
+        loss, ids, probs = eval_step(params, batch)
+        total += regularizer(params)      # params still alive
+    return total
+
+
+def jit_without_donation(step, params, batch, rng):
+    jstep = jax.jit(step)                 # no donate_argnums
+    out = jstep(params, batch, rng)
+    return out, params                    # nothing was donated
+
+
+def conditional_rebind_both_paths(step, params, batch, rng, fast):
+    jstep = jax.jit(step, donate_argnums=(0,))
+    if fast:
+        params = jstep(params, batch, rng)
+    else:
+        params = jstep(params, batch, rng)
+    return params                         # rebound on every path
+
+
+def init(dims):
+    return {}, {}
+
+
+def log_scalar(x):
+    pass
+
+
+def submit_save(s):
+    pass
+
+
+def compute_norm(p):
+    return 0.0
+
+
+def regularizer(p):
+    return 0.0

@@ -1,0 +1,86 @@
+"""donation-safety TRUE POSITIVES: reads of donated buffers.
+
+Parsed, never imported (fixtures README) — jax/optax here are fake.
+"""
+
+import functools
+
+import jax
+
+from fake_steps import make_train_step  # noqa: F401  (parse-only)
+
+
+def read_after_factory_step_donation(dims, optimizer, batches, rng):
+    """The acceptance shape: a make_train_step-style step's params are
+    read after the donating call (the caller kept the OLD name)."""
+    step = make_train_step(dims, optimizer)
+    params, opt_state = init(dims)
+    for batch in batches:
+        new_params, new_opt, loss = step(params, opt_state, batch, rng)
+        log_norm(params)          # TP: params was donated to step(...)
+        params, opt_state = new_params, new_opt
+    return params
+
+
+def return_of_donated(step_fn, params, opt_state, batch, rng):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new_params = step(params, opt_state, batch, rng)
+    del new_params
+    return params                 # TP: returning a deleted buffer
+
+
+def aliased_container_read(step, params, opt_state, batch, rng):
+    """The snapshot_state bug class: a dict built from params BEFORE
+    the donating call still aliases the donated buffers."""
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    state = {"params": params, "opt_state": opt_state}
+    params, opt_state, loss = jstep(params, opt_state, batch, rng)
+    save(state)                   # TP: state aliases donated buffers
+    return params, opt_state
+
+
+def donate_argnames_read(loss_fn, params, batch):
+    step = jax.jit(loss_fn, donate_argnames=("params",))
+    out = step(batch, params=params)
+    return out, params.mean()     # TP: attribute read of donated name
+
+
+def closure_capture_after_donation(step_fn, params, batch, rng):
+    step = functools.partial(jax.jit, donate_argnums=(0,))(step_fn)
+    new_params = step(params, batch, rng)
+
+    def report():
+        return summarize(params)  # TP: closure reads deleted buffers
+
+    return new_params, report
+
+
+class ModelWithStep:
+    def __init__(self, dims, optimizer):
+        self._train_step = make_train_step(dims, optimizer)
+
+    def train_one(self, params, opt_state, batch, rng):
+        new_p, new_o, loss = self._train_step(params, opt_state,
+                                              batch, rng)
+        self.last_norm = norm(params)  # TP: class-attr donor seam
+        return new_p, new_o, loss
+
+
+def init(dims):
+    return {}, {}
+
+
+def log_norm(p):
+    pass
+
+
+def save(s):
+    pass
+
+
+def norm(p):
+    return 0.0
+
+
+def summarize(p):
+    return 0.0

@@ -1,0 +1,111 @@
+"""thread-handoff TRICKY FALSE POSITIVES: the sanctioned handoff
+idioms — the rule must stay silent.
+
+Parsed, never imported — threading/queue here are fake.
+"""
+
+import threading
+
+
+class CleanBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = FakeQueue()
+        self.telemetry = None  # construction: single-threaded
+
+    def submit(self, req):
+        req.enqueued_at = now()       # mutate BEFORE the handoff
+        self._queue.put(req)
+
+    def submit_locked(self, req):
+        self._queue.put(req)
+        with self._lock:
+            req.batch_id = 7          # mutation under the class lock
+
+    def drain_and_reuse(self, items):
+        for item in items:
+            msg = wrap(item)
+            self._queue.put(msg)
+            msg = wrap(item)          # REBIND kills the escape
+            msg.retries = 0           # fresh object, never handed off
+
+
+def read_after_handoff_is_fine(state):
+    t = threading.Thread(target=work, args=(state,))
+    t.start()
+    report(state["phase"])            # reads are out of scope
+    t.join()
+    return state
+
+
+def local_then_publish(items):
+    """Build-then-publish: all mutation happens before the escape."""
+    batch = []
+    for item in items:
+        batch.append(item)            # still local
+    OUT_QUEUE.put(batch)
+    return len(items)
+
+
+def recording_monitor(deadline, telemetry):
+    """The watchdog discipline done right: the monitor thread records
+    the stall, it never raises."""
+    def monitor_loop():
+        while True:
+            try:
+                if overdue(deadline):
+                    raise RuntimeError("stalled")  # caught below
+            except RuntimeError:
+                telemetry_event(telemetry, "stall")
+
+    t = threading.Thread(target=monitor_loop, name="stall-monitor")
+    t.start()
+    return t
+
+
+def plain_worker_may_raise(path):
+    """Only monitor/watchdog threads get the never-raise sub-check —
+    an ordinary worker propagating into the excepthook is normal."""
+    def loader():
+        if missing(path):
+            raise FileNotFoundError(path)
+
+    t = threading.Thread(target=loader, name="shard-loader")
+    t.start()
+    return t
+
+
+class FakeQueue:
+    def put(self, item):
+        pass
+
+
+OUT_QUEUE = FakeQueue()
+
+
+def now():
+    return 0.0
+
+
+def wrap(x):
+    return x
+
+
+def work(s):
+    pass
+
+
+def report(x):
+    pass
+
+
+def overdue(d):
+    return False
+
+
+def telemetry_event(t, name):
+    pass
+
+
+def missing(p):
+    return False

@@ -1,0 +1,89 @@
+"""thread-handoff TRUE POSITIVES: objects mutated after crossing a
+thread boundary, plus the raise-from-monitor-thread discipline.
+
+Parsed, never imported — threading/queue here are fake.
+"""
+
+import threading
+
+
+class RacyBatcher:
+    """The PR-4 MicroBatcher shape: the request keeps being mutated
+    after the consumer thread may already have dequeued it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = FakeQueue()
+
+    def submit(self, req):
+        self._queue.put(req)
+        req.enqueued_at = now()       # TP: mutated after queue.put
+
+    def submit_batch(self, reqs, req):
+        self._queue.put(req)
+        with self._lock:
+            req.batch_id = 7          # locked: fine
+        req.retries += 1              # TP: aug-mutation outside lock
+
+
+def thread_args_mutation(state):
+    worker = make_worker()
+    t = threading.Thread(target=worker, args=(state,))
+    t.start()
+    state["phase"] = "running"        # TP: subscript store after handoff
+    t.join()
+
+
+def executor_submit_mutation(pool, job):
+    fut = pool.submit(run_job, job)
+    job.cancelled = False             # TP: worker may already read it
+    return fut
+
+
+def aug_extend_after_put(queue, rows):
+    batch = list(rows)
+    queue.put(batch)
+    batch += ["tail"]                 # TP: in-place extend after handoff
+    return batch
+
+
+class SharedStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current = None
+
+    def publish(self, item):
+        self._current = item          # escapes: other threads see self
+        item.append("late")           # TP: mutator call after publish
+
+
+def raising_monitor(deadline):
+    def monitor_loop():
+        while True:
+            if overdue(deadline):
+                raise RuntimeError("stalled")  # TP: kills the monitor
+
+    t = threading.Thread(target=monitor_loop, name="stall-monitor")
+    t.start()
+    return t
+
+
+class FakeQueue:
+    def put(self, item):
+        pass
+
+
+def now():
+    return 0.0
+
+
+def make_worker():
+    return lambda s: None
+
+
+def run_job(job):
+    pass
+
+
+def overdue(d):
+    return False

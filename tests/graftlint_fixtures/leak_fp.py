@@ -1,0 +1,151 @@
+"""resource-leak TRICKY FALSE POSITIVES: every release discipline the
+rule must credit (try/finally, except-handler releases, context
+managers, ownership transfer, daemon threads).
+
+Parsed, never imported — tracer/threading here are fake.
+"""
+
+import threading
+
+
+def finally_release(tracer, req):
+    root = tracer.start_trace("serve/request")
+    try:
+        result = handle(req)
+    finally:
+        root.end()                    # dominates every exit
+    return result
+
+
+def handler_release_and_reraise(tracer, req):
+    """The shape server.py actually ships (the PR-6 fix): the error
+    path closes the trace and re-raises."""
+    root = tracer.start_trace("serve/request")
+    ex_span = tracer.start_span("serve/extract") \
+        if root is not None else None
+    try:
+        lines = extract(req)
+    except BaseException:
+        if root is not None:
+            ex_span.end()
+            root.end(outcome="error")
+        raise
+    if ex_span is not None:
+        ex_span.end()
+    root.end(n=len(lines))
+    return lines
+
+
+def context_manager_span(tracer, req):
+    with tracer.start_span("serve/decode"):
+        return handle(req)
+
+
+def with_as_span(telemetry, batch):
+    with telemetry.span("serve/parse_ms") as sp:
+        rows = parse(batch)
+        sp.annotate(n=len(rows))
+    return rows
+
+
+def ownership_transfer(tracer, sink):
+    sp = tracer.start_span("serve/request")
+    sink.adopt(sp)                    # receiver owns the release now
+    return True
+
+
+def alias_transfer(telemetry):
+    sp = telemetry.span("serve/x_ms")
+    handle = sp                       # the alias owns the release now
+    handle.stop()
+    return True
+
+
+def container_transfer(tracer, open_spans):
+    sp = tracer.start_span("serve/request")
+    open_spans = [sp]                 # whoever drains the list releases
+    return open_spans
+
+
+def yielded_resource(tracer, reqs):
+    for req in reqs:
+        sp = tracer.start_span("serve/request", req=req)
+        yield sp                      # the consumer owns the release
+
+
+def returned_resource(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return t                          # caller owns the join
+
+
+def daemon_thread_sanctioned(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return t.name                     # daemons are never joined
+
+
+def submit_then_barrier(state, step):
+    writer = FakeWriter()
+    writer.submit(state, step)
+    writer.wait()
+    writer.close()
+    return state
+
+
+def borrowed_writer_submit(get_writer, state, step):
+    """A writer fetched from elsewhere is BORROWED — its lifecycle
+    belongs to the owner, submit here needs no local barrier."""
+    writer = get_writer()
+    writer.submit(state, step)
+    return state
+
+
+def lock_with_statement(lock):
+    with lock:
+        return critical()
+
+
+def conditional_release_is_credited(tracer, work, block):
+    """Documented under-reach: a release under ANY branch counts —
+    correlating the guard with the acquire (`if sp is not None:`
+    vs `if block:`) is beyond static reach, and the guarded-release
+    idiom is everywhere in the shipped serving layer."""
+    t = threading.Thread(target=work)
+    t.start()
+    if block:
+        t.join()
+    return t.name
+
+
+def match_span_is_not_a_resource(pattern, text):
+    m = pattern.search(text)
+    start, end = m.span()             # re.Match.span: just a tuple
+    return text[start:end]
+
+
+def handle(req):
+    return []
+
+
+def extract(req):
+    return []
+
+
+def parse(b):
+    return []
+
+
+def critical():
+    return True
+
+
+class FakeWriter:
+    def submit(self, state, step):
+        pass
+
+    def wait(self):
+        pass
+
+    def close(self):
+        pass

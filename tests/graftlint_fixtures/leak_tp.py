@@ -1,0 +1,79 @@
+"""resource-leak TRUE POSITIVES: acquires whose release can be
+skipped.
+
+Parsed, never imported — tracer/threading here are fake.
+"""
+
+import threading
+
+
+def leaked_span_on_error(tracer, req):
+    """THE PR-6 shape: an exception in handle() leaks the request
+    span into the live-span table forever."""
+    root = tracer.start_trace("serve/request")
+    result = handle(req)              # TP: can raise while root held
+    root.end(n=len(result))
+    return result
+
+
+def telemetry_span_error_window(telemetry, batch):
+    span = telemetry.span("serve/extract_ms")
+    rows = parse(batch)               # TP: leaks span on a bad batch
+    span.stop()
+    return rows
+
+
+def early_return_leaks(tracer, lines):
+    sp = tracer.start_span("serve/parse")
+    if not lines:
+        return []                     # TP: sp never ended on this path
+    out = decode(lines)
+    sp.end()
+    return out
+
+
+def thread_never_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    wait_for_side_effect()
+    return True                       # TP: started thread never joined
+
+
+def submit_without_barrier(state, step):
+    writer = FakeWriter()
+    writer.submit(state, step)
+    return state                      # TP: no wait/close — job may be
+    #                                   in flight at interpreter exit
+
+
+def acquire_without_release(lock):
+    lock.acquire()
+    if contended(lock):
+        return False                  # TP: held lock leaks on return
+    lock.release()
+    return True
+
+
+def handle(req):
+    return []
+
+
+def parse(b):
+    return []
+
+
+def decode(x):
+    return x
+
+
+def wait_for_side_effect():
+    pass
+
+
+def contended(lk):
+    return False
+
+
+class FakeWriter:
+    def submit(self, state, step):
+        pass

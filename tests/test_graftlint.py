@@ -10,6 +10,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -23,6 +24,14 @@ from tools.graftlint.rules.test_markers import (TestMarkerRule,
 REPO = REPO_ROOT
 FIXTURES = os.path.join(REPO, "tests", "graftlint_fixtures")
 
+# every registered rule — extended by the ISSUE 12 dataflow trio; the
+# no-baseline gate below runs ALL of them, so serving/obs/training/
+# ops/parallel/resilience must come up clean under the new rules too
+ALL_RULES = {"host-sync-in-hot-path", "retrace-hazard",
+             "lock-discipline", "config-drift", "test-marker-hygiene",
+             "swallowed-error", "donation-safety", "thread-handoff",
+             "resource-leak"}
+
 
 def _fx(name):
     return os.path.join(FIXTURES, name)
@@ -31,10 +40,33 @@ def _fx(name):
 # ---- the repo itself must lint clean (the CI gate) ----
 
 @pytest.fixture(scope="module")
-def repo_findings():
-    """ONE repo-wide scan shared by the gate tests (it dominates the
-    suite's runtime; the assertions are independent views of it)."""
-    return run_lint(DEFAULT_PATHS, root=REPO)
+def repo_scan():
+    """ONE timed repo-wide scan shared by the gate tests (it dominates
+    the suite's runtime; the assertions are independent views of it).
+    -> (findings, elapsed_seconds)"""
+    t0 = time.perf_counter()
+    findings = run_lint(DEFAULT_PATHS, root=REPO)
+    return findings, time.perf_counter() - t0
+
+
+@pytest.fixture(scope="module")
+def repo_findings(repo_scan):
+    return repo_scan[0]
+
+
+def test_all_nine_rules_registered():
+    assert set(all_rules()) == ALL_RULES
+
+
+def test_full_scan_performance(repo_scan):
+    """Tier-1 guard (ISSUE 12 satellite): the full-repo scan with all
+    9 rules must stay comfortably inside the pre-commit budget — the
+    dataflow core's one-pass loop fixpoint is O(statements) per
+    function, and this bound is how we notice if a rule change quietly
+    goes quadratic. Generous: the scan measures ~2-4 s on a loaded CI
+    core."""
+    _findings, elapsed = repo_scan
+    assert elapsed < 60.0, f"full graftlint scan took {elapsed:.1f}s"
 
 
 def test_repo_lints_clean_against_baseline(repo_findings):
@@ -137,6 +169,91 @@ def test_swallowed_error_fixtures():
     assert all("swallows the error" in f.message for f in tp)
     fp = _rule_findings("swallowed-error", [_fx("swallowed_fp.py")])
     assert fp == [], "\n".join(f.render() for f in fp)
+
+
+def test_donation_safety_fixtures():
+    """ISSUE 12 acceptance: a post-donation read of a make_train_step-
+    style step's params must flag; the snapshot_state pattern (and the
+    rebind idiom) must stay quiet."""
+    tp = _rule_findings("donation-safety", [_fx("donation_tp.py")])
+    assert {f.symbol for f in tp} == {
+        "read_after_factory_step_donation", "return_of_donated",
+        "aliased_container_read", "donate_argnames_read",
+        "closure_capture_after_donation", "ModelWithStep.train_one"}
+    msgs = " ".join(f.message for f in tp)
+    assert "donated" in msgs and "snapshot_state" in msgs
+    # the alias shape names the flow; the closure shape names capture
+    assert any("through an alias" in f.message for f in tp)
+    assert any("captured by a nested function" in f.message for f in tp)
+    # the donation site is context, NOT baseline identity (line moves
+    # must not resurrect entries)
+    assert all("donated at line" in f.detail
+               and "line" not in f.message for f in tp)
+    fp = _rule_findings("donation-safety", [_fx("donation_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
+def test_thread_handoff_fixtures():
+    tp = _rule_findings("thread-handoff", [_fx("handoff_tp.py")])
+    assert {f.symbol for f in tp} == {
+        "RacyBatcher.submit", "RacyBatcher.submit_batch",
+        "thread_args_mutation", "executor_submit_mutation",
+        "aug_extend_after_put", "SharedStore.publish",
+        "raising_monitor"}
+    # every escape vector is represented
+    msgs = " ".join(f.message for f in tp)
+    for needle in ("Thread(...)", ".put(...)", ".submit(...)",
+                   "self._current = ..."):
+        assert needle in msgs, needle
+    # the monitor sub-check: never raise from the monitor thread
+    monitor = [f for f in tp if f.symbol == "raising_monitor"]
+    assert monitor and "monitor" in monitor[0].message \
+        and "record the failure" in monitor[0].message
+    fp = _rule_findings("thread-handoff", [_fx("handoff_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
+def test_resource_leak_fixtures():
+    """ISSUE 12 acceptance: the PR-6 leaked-span shape must flag;
+    try/finally, except-handler and context-manager releases must stay
+    quiet."""
+    tp = _rule_findings("resource-leak", [_fx("leak_tp.py")])
+    syms = {f.symbol for f in tp}
+    assert syms == {
+        "leaked_span_on_error", "telemetry_span_error_window",
+        "early_return_leaks", "thread_never_joined",
+        "submit_without_barrier", "acquire_without_release"}
+    msgs = " ".join(f.message for f in tp)
+    assert "PR-6 leaked-span class" in msgs       # the error-path form
+    assert "not released on every path" in msgs   # the exit-leak form
+    # early_return_leaks exhibits BOTH hazards on one span
+    assert len([f for f in tp
+                if f.symbol == "early_return_leaks"]) == 2
+    fp = _rule_findings("resource-leak", [_fx("leak_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
+def test_dataflow_sees_defs_in_match_and_async_with():
+    """Regression (review): a def nested in a match-case arm or an
+    async-with body is still a frame — a span leak there must flag."""
+    import ast as ast_mod
+    from tools.graftlint import dataflow as df
+    src = (
+        "async def outer(cm, mode, tracer, req):\n"
+        "    match mode:\n"
+        "        case 'a':\n"
+        "            def in_match():\n"
+        "                sp = tracer.start_span('x')\n"
+        "                handle(req)\n"
+        "                sp.end()\n"
+        "    async with cm:\n"
+        "        def in_async_with():\n"
+        "            sp2 = tracer.start_span('y')\n"
+        "            handle(req)\n"
+        "            sp2.end()\n")
+    names = {fn.name for fn, _cls in
+             df.iter_functions(ast_mod.parse(src))}
+    assert {"in_match", "in_async_with"} <= names
 
 
 def test_marker_fixtures():
@@ -247,6 +364,9 @@ def test_cli_runs_clean_without_jax_or_tf(tmp_path):
                        text=True, timeout=30)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 findings" in r.stdout
+    # ALL nine rules ran under the import block — the dataflow core
+    # (ISSUE 12) must hold parse-never-import like everything else
+    assert f"rules: {len(ALL_RULES)})" in r.stdout
 
 
 def test_cli_json_format_and_rule_selection(capsys):
@@ -268,6 +388,79 @@ def test_cli_guards_partial_baseline_and_bad_paths(tmp_path, capsys):
     assert main(["--write-baseline", "tools"]) == 2
     # a typo'd path scanning zero files must not report "clean"
     assert main(["serving"]) == 2
+    capsys.readouterr()
+
+
+def test_changed_py_files_tracks_git(tmp_path):
+    """--changed's file list (ISSUE 12 satellite): worktree diff +
+    untracked, scan-set-scoped, fixture dirs excluded, deletions
+    dropped."""
+    from tools.graftlint.__main__ import changed_py_files
+    repo = str(tmp_path / "r")
+    os.makedirs(os.path.join(repo, "tools", "graftlint_fixtures"))
+    os.makedirs(os.path.join(repo, "docs"))
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", *args], cwd=repo,
+                       check=True, capture_output=True)
+
+    def write(rel, text="x = 1\n"):
+        with open(os.path.join(repo, rel), "w") as f:
+            f.write(text)
+
+    git("init", "-q")
+    write("tools/clean.py")
+    write("tools/gone.py")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    assert changed_py_files(repo) == []
+    write("tools/clean.py", "x = 2\n")          # modified
+    write("tools/fresh.py")                      # untracked
+    write("tools/graftlint_fixtures/tp.py")      # excluded dir
+    write("docs/outside.py")                     # outside the scan set
+    write("tools/notes.txt")                     # not .py
+    os.remove(os.path.join(repo, "tools", "gone.py"))  # deleted
+    assert changed_py_files(repo) == ["tools/clean.py",
+                                      "tools/fresh.py"]
+
+
+def test_cli_changed_mode_gates_a_diff(tmp_path, capsys):
+    """`--changed` end-to-end on a HERMETIC tmp git repo (linting the
+    developer's live worktree here would fail on THEIR in-flight
+    changes): a clean modified file passes, a planted finding fails,
+    and the flag refuses path arguments / --write-baseline
+    combinations that would silently narrow the gate."""
+    from tools.graftlint.__main__ import main
+    repo = str(tmp_path / "r")
+    os.makedirs(os.path.join(repo, "tools"))
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", *args], cwd=repo,
+                       check=True, capture_output=True)
+
+    def write(rel, text):
+        with open(os.path.join(repo, rel), "w") as f:
+            f.write(text)
+
+    git("init", "-q")
+    write("tools/mod.py", "x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    write("tools/mod.py", "y = 2\n")
+    assert main(["--changed", "--root", repo]) == 0
+    write("tools/mod.py",
+          "def f():\n"
+          "    try:\n"
+          "        g()\n"
+          "    except Exception:\n"
+          "        pass\n")
+    assert main(["--changed", "--root", repo]) == 1
+    out = capsys.readouterr().out
+    assert "swallowed-error" in out
+    assert main(["--changed", "tools"]) == 2
+    assert main(["--changed", "--write-baseline"]) == 2
     capsys.readouterr()
 
 

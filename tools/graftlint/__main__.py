@@ -3,11 +3,18 @@
     python -m tools.graftlint                       # default scan set
     python -m tools.graftlint --format json serving
     python -m tools.graftlint --rules lock-discipline,config-drift
+    python -m tools.graftlint --changed             # pre-commit fast path
     python -m tools.graftlint --write-baseline      # regenerate + review
 
 Exit status: 0 = no non-baselined findings, 1 = findings, 2 = usage.
 Stale baseline entries (fixed findings whose entry lingers) are
 reported but do not fail the run — `--write-baseline` drops them.
+
+`--changed` lints only the .py files `git diff --name-only <base>`
+(plus untracked files) reports under the default scan set — the
+pre-commit gate stops paying the full-repo scan on every commit; the
+full scan still runs as tier-1 (tests/test_graftlint.py), so repo-wide
+rules (call-graph reachability, config drift) lose nothing.
 """
 
 from __future__ import annotations
@@ -15,12 +22,42 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List
 
 from tools.graftlint import baseline as baseline_mod
-from tools.graftlint.core import (DEFAULT_PATHS, REPO_ROOT, all_rules,
-                                  iter_py_files, run_lint)
+from tools.graftlint.core import (DEFAULT_PATHS, EXCLUDE_DIRS,
+                                  REPO_ROOT, all_rules, iter_py_files,
+                                  run_lint)
+
+
+def changed_py_files(root: str, base: str = "HEAD") -> List[str]:
+    """Repo-root-relative .py paths changed vs `base` (worktree diff +
+    untracked), restricted to the default scan set and graftlint's
+    exclude rules. Deleted files are dropped (nothing to parse)."""
+    def git(*args: str) -> List[str]:
+        # quotepath=off: git would otherwise octal-escape-and-quote
+        # non-ASCII paths, which then fail the isfile check and skip
+        # the file from the gate silently
+        out = subprocess.run(["git", "-c", "core.quotepath=off",
+                              *args], cwd=root,
+                             capture_output=True, text=True, check=True)
+        return [ln.strip() for ln in out.stdout.splitlines()
+                if ln.strip()]
+
+    names = set(git("diff", "--name-only", base))
+    names.update(git("ls-files", "--others", "--exclude-standard"))
+    kept = []
+    for rel in sorted(names):
+        parts = rel.split("/")
+        if not rel.endswith(".py") or parts[0] not in DEFAULT_PATHS:
+            continue
+        if any(p in EXCLUDE_DIRS for p in parts):
+            continue  # fixtures plant deliberate true positives
+        if os.path.isfile(os.path.join(root, rel)):
+            kept.append(rel)
+    return kept
 
 
 def main(argv: List[str] = None) -> int:
@@ -41,6 +78,11 @@ def main(argv: List[str] = None) -> int:
                         "graftlint_baseline.json)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report every finding, grandfathered or not")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs --base (git diff "
+                        "+ untracked) — the fast pre-commit path")
+    p.add_argument("--base", default="HEAD",
+                   help="base ref for --changed (default: HEAD)")
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from this run's findings "
                         "(refuses serving/ and obs/ entries) and exit 0")
@@ -55,6 +97,30 @@ def main(argv: List[str] = None) -> int:
             print(f"unknown rule(s): {', '.join(unknown)} "
                   f"(have: {', '.join(sorted(rules))})", file=sys.stderr)
             return 2
+
+    if args.changed:
+        if args.paths != list(DEFAULT_PATHS):
+            print("--changed computes its own file list; drop the "
+                  "path arguments", file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("--write-baseline needs the full scan (a changed-"
+                  "only baseline would drop every other entry)",
+                  file=sys.stderr)
+            return 2
+        try:
+            args.paths = changed_py_files(args.root, args.base)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"--changed: git failed: {e}", file=sys.stderr)
+            return 2
+        if not args.paths:
+            if args.format == "json":
+                print(json.dumps({"findings": [], "grandfathered": 0,
+                                  "stale_baseline": []}, indent=2))
+            else:
+                print(f"graftlint: no changed .py files vs {args.base}"
+                      " — 0 findings")
+            return 0
 
     try:
         findings = run_lint(args.paths, root=args.root, rules=selected)

@@ -1,0 +1,428 @@
+"""graftflow: the shared intraprocedural dataflow core (ISSUE 12).
+
+graftlint's first six rules are per-node pattern matchers; the bug
+classes the last five PRs kept fixing by hand — reads of donated
+buffers, objects mutated after a thread handoff, acquire-without-
+release on error paths — all require tracking a VALUE across
+statements. This module owns that machinery once, so the three
+dataflow rules (donation-safety, thread-handoff, resource-leak) are
+just transfer functions:
+
+  - a statement-ordered CFG walk per function: sequencing is program
+    order; `if`/`try`/`match` branches are both executed on copies of
+    the state and JOINED conservatively (a fact on either side
+    survives); loops run ONE fixpoint pass (body executed twice with a
+    join in between — enough to propagate loop-carried facts like "a
+    name tainted at the bottom of the body is tainted at the top",
+    without iterating to convergence);
+  - per-name def-use facts: rules attach a fact to a dotted name
+    (`params`, `self.opt_state`) when it is defined or flows somewhere
+    interesting, and REASSIGNMENT KILLS it — `params, opt, loss =
+    step(params, opt, ...)` launders the name on the same statement
+    that donated it, which is why the normal train-loop idiom is clean
+    by construction;
+  - a lightweight escape lattice: LOCAL (the function owns the value)
+    < ALIASED (another local name may refer to the same object) <
+    ESCAPED (handed to another thread/queue/executor or stored where
+    another thread can see it). Rules consult the lattice instead of
+    re-deriving "who else can touch this".
+
+Under-reach policy (the tool's documented design, ARCHITECTURE.md
+"Dataflow: taint what escapes, kill on reassign"): whenever the
+analysis cannot prove the hazardous flow — an unresolvable call, a
+subscripted target, a name rebound through `exec`-level dynamism — it
+drops the fact rather than guessing. A dataflow rule that sprays
+plausible-but-wrong findings gets suppressed into uselessness; one
+that only speaks when the chain is airtight gets fixed.
+
+Everything here is pure `ast` + stdlib (the graftlint contract: parse,
+never import).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
+
+# ---- escape lattice ----
+
+LOCAL = 0      # only this function's frame can reach the value
+ALIASED = 1    # another local name may refer to the same object
+ESCAPED = 2    # another thread/queue/executor/shared object can reach it
+
+_LEAF_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+               ast.Assert, ast.Delete, ast.Import, ast.ImportFrom,
+               ast.Global, ast.Nonlocal, ast.Pass)
+
+
+# ---- name extraction helpers (the def/use vocabulary) ----
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for a Name/Attribute chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_name_or_prefix(read: str, name: str) -> bool:
+    """True when a read of `read` touches the value bound to `name`:
+    the name itself or an attribute path under it (`params.shape`
+    reads `params`; `self` does not read `self.params`)."""
+    return read == name or read.startswith(name + ".")
+
+
+def bound_names(target: ast.AST) -> List[str]:
+    """Dotted names REBOUND by an assignment target (tuple/list/star
+    unpacking flattened). Subscript targets (`x[k] = v`) mutate, they
+    do not rebind — they are excluded here (see `mutated_bases`)."""
+    out: List[str] = []
+    stack = [target]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        elif isinstance(t, (ast.Name, ast.Attribute)):
+            d = dotted(t)
+            if d:
+                out.append(d)
+    return out
+
+
+def mutated_bases(target: ast.AST) -> List[str]:
+    """Dotted base names MUTATED (not rebound) by an assignment
+    target: `x[k] = v` and `x.a = v` mutate `x`; plain `x = v` does
+    not. For `x.a = v` both the mutation of `x` and the rebind of
+    `x.a` are real — callers pick the view they need."""
+    out: List[str] = []
+    stack = [target]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        elif isinstance(t, ast.Subscript):
+            d = dotted(t.value)
+            if d:
+                out.append(d)
+        elif isinstance(t, ast.Attribute):
+            d = dotted(t.value)
+            if d:
+                out.append(d)
+    return out
+
+
+def reads(expr: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Every dotted name READ inside an expression tree, as (name,
+    node). An Attribute chain yields its full dotted path once (the
+    rules prefix-match); Store/Del contexts are skipped. Descends into
+    lambdas and comprehensions — a closure read is still a read."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute):
+            if isinstance(n.ctx, ast.Load):
+                d = dotted(n)
+                if d:
+                    yield d, n
+                    # the chain's names are covered by the prefix
+                    # match; don't also yield the inner Name
+                    stack.extend(a for a in ast.iter_child_nodes(n)
+                                 if not isinstance(a, (ast.Name,
+                                                       ast.Attribute)))
+                    continue
+            stack.append(n.value)
+            continue
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load):
+                yield n.id, n
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def arg_names(call: ast.Call) -> List[Tuple[Optional[str], str, ast.AST]]:
+    """(keyword-or-None, dotted-name, node) for every plain-name
+    argument of a call. Complex argument expressions are skipped —
+    their values are temporaries no later statement can read
+    (under-reach)."""
+    out: List[Tuple[Optional[str], str, ast.AST]] = []
+    for a in call.args:
+        node = a.value if isinstance(a, ast.Starred) else a
+        d = dotted(node)
+        if d:
+            out.append((None, d, node))
+    for kw in call.keywords:
+        d = dotted(kw.value)
+        if d:
+            out.append((kw.arg, d, kw.value))
+    return out
+
+
+def stmt_may_raise(stmt: ast.AST) -> bool:
+    """Heuristic: a statement containing any call (or an explicit
+    raise/assert) can leave the function exceptionally. Attribute and
+    subscript reads can too, but flagging those would make every
+    statement 'risky' — calls are where the PR-6 leak class actually
+    fired."""
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert, ast.Await)):
+            return True
+    return False
+
+
+# every compound statement a def can hide inside — a function defined
+# in a match-case arm or an async-with body is still a frame to analyze
+_CONTAINER_STMTS = (ast.If, ast.Try, ast.With, ast.AsyncWith,
+                    ast.For, ast.AsyncFor, ast.While,
+                    ast.ExceptHandler) + tuple(
+    getattr(ast, n) for n in ("Match", "match_case")
+    if hasattr(ast, n))
+
+
+def iter_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, str]]:
+    """(function-node, enclosing-class-name) for every def in a module,
+    including nested ones (each is analyzed as its own frame)."""
+    stack: List[Tuple[ast.AST, str]] = [(tree, "")]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name))
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield child, cls
+                stack.append((child, cls))
+            elif isinstance(child, _CONTAINER_STMTS):
+                stack.append((child, cls))
+    return
+
+
+# ---- the flow engine ----
+
+class FlowVisitor:
+    """Transfer-function interface a dataflow rule implements. The
+    engine owns control flow (sequencing, branch copies + joins, the
+    one-pass loop fixpoint, path death after return/raise/break); the
+    visitor owns the state and the findings.
+
+    State objects are opaque to the engine — it only ever calls
+    `copy_state` and `join_states`. A `None` state is a dead path
+    (after return/raise); `join_states` never sees one."""
+
+    def initial_state(self, fn: ast.AST) -> Any:
+        return {}
+
+    def copy_state(self, state: Any) -> Any:
+        return dict(state)
+
+    def join_states(self, a: Any, b: Any) -> Any:
+        """Conservative branch join: a fact surviving on EITHER side
+        survives the join. Default: union, keeping `a`'s fact on
+        conflict."""
+        out = dict(b)
+        out.update(a)
+        return out
+
+    # --- hooks the engine calls in execution order ---
+
+    def on_stmt(self, stmt: ast.AST, state: Any) -> None:
+        """A leaf statement (Assign/Expr/Return/Raise/...)."""
+
+    def on_expr(self, expr: ast.AST, state: Any) -> None:
+        """A control expression evaluated outside a leaf statement:
+        an `if`/`while` test, a `for` iterable, a `with` item."""
+
+    def on_bind(self, target: ast.AST, state: Any, source: str,
+                value: Optional[ast.AST] = None) -> None:
+        """A binding outside a leaf Assign: `for` targets
+        (source='for'), `with ... as` (source='with', value=the
+        context expr), `except ... as` (source='except'). Default:
+        kill facts for the rebound names."""
+        for name in bound_names(target):
+            state.pop(name, None)
+
+    def on_nested_def(self, node: ast.AST, state: Any) -> None:
+        """A nested FunctionDef/AsyncFunctionDef/ClassDef — the engine
+        does NOT descend (it runs at call time, in its own frame)."""
+
+    def on_with(self, stmt: ast.AST, state: Any) -> Any:
+        """Entering a with-block (after items were evaluated/bound).
+        Returns a token passed back to `after_with`."""
+        return None
+
+    def after_with(self, token: Any, state: Optional[Any]) -> None:
+        pass
+
+    def on_try(self, stmt: ast.Try, state: Any) -> Any:
+        """Entering a try. Returns a token passed to `after_try`;
+        rules use it to register finally/handler protection."""
+        return None
+
+    def after_try(self, token: Any, state: Optional[Any]) -> None:
+        pass
+
+    def enter_finally(self) -> None:
+        pass
+
+    def exit_finally(self) -> None:
+        pass
+
+    def at_exit(self, fn: ast.AST, state: Any) -> None:
+        """The implicit return at the end of the body (only reachable
+        fall-off paths — a trailing `raise` never gets here)."""
+
+
+class _LoopCtx:
+    __slots__ = ("breaks", "continues")
+
+    def __init__(self):
+        self.breaks: List[Any] = []
+        self.continues: List[Any] = []
+
+
+def run_flow(fn: ast.AST, visitor: FlowVisitor) -> None:
+    """Drive `visitor` over `fn`'s body in execution order with the
+    CFG policy above."""
+    state = visitor.initial_state(fn)
+    state = _run_body(fn.body, visitor, state, [])
+    if state is not None:
+        visitor.at_exit(fn, state)
+
+
+def _join(v: FlowVisitor, a: Any, b: Any) -> Any:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return v.join_states(a, b)
+
+
+def _run_body(body: Iterable[ast.AST], v: FlowVisitor, state: Any,
+              loops: List[_LoopCtx]) -> Any:
+    for stmt in body:
+        if state is None:
+            break  # unreachable code: under-reach, don't analyze
+        state = _exec(stmt, v, state, loops)
+    return state
+
+
+def _exec(stmt: ast.AST, v: FlowVisitor, state: Any,
+          loops: List[_LoopCtx]) -> Any:
+    if isinstance(stmt, _LEAF_STMTS):
+        v.on_stmt(stmt, state)
+        return state
+
+    if isinstance(stmt, ast.Return):
+        v.on_stmt(stmt, state)
+        return None
+    if isinstance(stmt, ast.Raise):
+        v.on_stmt(stmt, state)
+        return None
+    if isinstance(stmt, ast.Break):
+        if loops:
+            loops[-1].breaks.append(v.copy_state(state))
+        return None
+    if isinstance(stmt, ast.Continue):
+        if loops:
+            loops[-1].continues.append(v.copy_state(state))
+        return None
+
+    if isinstance(stmt, ast.If):
+        v.on_expr(stmt.test, state)
+        s_then = _run_body(stmt.body, v, v.copy_state(state), loops)
+        s_else = _run_body(stmt.orelse, v, state, loops)
+        return _join(v, s_then, s_else)
+
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        loop = _LoopCtx()
+        loops.append(loop)
+        try:
+            # one fixpoint pass: execute the body twice, joining with
+            # the pre-loop state (zero iterations) and the first
+            # pass's exit (loop-carried facts) in between
+            for _ in range(2):
+                if isinstance(stmt, ast.While):
+                    v.on_expr(stmt.test, state)
+                else:
+                    v.on_expr(stmt.iter, state)
+                    v.on_bind(stmt.target, state, "for")
+                s_body = _run_body(stmt.body, v, v.copy_state(state),
+                                   loops)
+                for s_cont in loop.continues:
+                    s_body = _join(v, s_body, s_cont)
+                loop.continues.clear()
+                state = _join(v, state, s_body)
+        finally:
+            loops.pop()
+        for s_brk in loop.breaks:
+            state = _join(v, state, s_brk)
+        if stmt.orelse:
+            state = _run_body(stmt.orelse, v, state, loops)
+        return state
+
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            v.on_expr(item.context_expr, state)
+            if item.optional_vars is not None:
+                v.on_bind(item.optional_vars, state, "with",
+                          value=item.context_expr)
+        token = v.on_with(stmt, state)
+        state = _run_body(stmt.body, v, state, loops)
+        v.after_with(token, state)
+        return state
+
+    if isinstance(stmt, ast.Try):
+        token = v.on_try(stmt, state)
+        entry = v.copy_state(state)
+        s_body = _run_body(stmt.body, v, state, loops)
+        handler_states = []
+        for h in stmt.handlers:
+            # an exception can arrive from ANY point in the body: the
+            # handler sees the entry state joined with the body-exit
+            # state (facts born inside the body may or may not exist)
+            hs = _join(v, v.copy_state(entry),
+                       None if s_body is None else v.copy_state(s_body))
+            if h.name:
+                v.on_bind(ast.Name(id=h.name, ctx=ast.Store()), hs,
+                          "except")
+            handler_states.append(_run_body(h.body, v, hs, loops))
+        out = s_body
+        if stmt.orelse and out is not None:
+            out = _run_body(stmt.orelse, v, out, loops)
+        for hs in handler_states:
+            out = _join(v, out, hs)
+        if stmt.finalbody:
+            fin_in = out if out is not None else entry
+            v.enter_finally()
+            try:
+                out = _run_body(stmt.finalbody, v, fin_in, loops)
+            finally:
+                v.exit_finally()
+        v.after_try(token, out)
+        return out
+
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        v.on_nested_def(stmt, state)
+        if isinstance(state, dict):
+            state.pop(stmt.name, None)  # the def name is a rebind
+        return state
+
+    if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+        v.on_expr(stmt.subject, state)
+        out = v.copy_state(state)  # no-match path
+        for case in stmt.cases:
+            cs = _run_body(case.body, v, v.copy_state(state), loops)
+            out = _join(v, out, cs)
+        return out
+
+    # anything else (future syntax): treat as an opaque leaf
+    v.on_stmt(stmt, state)
+    return state

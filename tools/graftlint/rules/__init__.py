@@ -12,3 +12,6 @@ Adding a rule (README "Static analysis" has the user-facing steps):
 from tools.graftlint.rules import (config_drift, host_sync,  # noqa: F401
                                    lock_discipline, retrace,
                                    swallowed_error, test_markers)
+# the dataflow rules (ISSUE 12) — built on tools/graftlint/dataflow.py
+from tools.graftlint.rules import (donation_safety,  # noqa: F401
+                                   resource_leak, thread_handoff)

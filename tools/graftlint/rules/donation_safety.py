@@ -1,0 +1,422 @@
+"""donation-safety: a read of a buffer after it was donated to a step.
+
+The train steps donate their params/opt_state (`jax.jit(...,
+donate_argnums=(0, 1))`): XLA reuses the input buffers for the
+outputs, so after the call the ORIGINAL arrays are deleted — a later
+read returns garbage on TPU and silently works on CPU, which is
+exactly why `snapshot_state` exists (PR 5: the async checkpoint
+writer reading donated params) and why pytest never sees this class.
+
+The normal idiom is clean BY CONSTRUCTION — the same statement that
+donates rebinds the name, which kills the taint:
+
+    params, opt_state, loss = step(params, opt_state, batch, rng)  # ok
+
+The bug shapes this rule catches (dataflow over tools/graftlint/
+dataflow.py, per-function):
+
+    new_p, new_o, loss = step(params, opt_state, batch, rng)
+    save(params)                        # read of a donated buffer
+
+    state = {"params": params}          # state aliases params' buffers
+    params, opt, loss = step(params, opt, batch, rng)
+    writer.submit(state)                # aliased read of donated buffers
+
+Donating callables are recognized from:
+  - a name bound (function/module scope) to `jit`/`pjit` with a
+    literal `donate_argnums=`/`donate_argnames=` (incl. through
+    `functools.partial(jax.jit, ...)`), or to one of the repo's step
+    factories (`make_train_step` & friends — the ONE step-construction
+    seam, training/steps.py);
+  - a def decorated with jit-with-donate, called by name in its file;
+  - `self.X = make_train_step(...)`-style class attributes, called as
+    `self.X(...)` in any method of that class (models/jax_model.py's
+    `self._train_step`);
+  - an immediately-invoked `jax.jit(f, donate_argnums=...)(...)`.
+
+Sanction: a name assigned from `snapshot_state(...)` (or an explicit
+copy: `jnp.copy`, `copy.deepcopy`, `.copy()`, `jax.device_get`) holds
+fresh buffers — it never inherits taint through the alias edge, which
+is precisely what makes the snapshot-then-step checkpoint idiom clean.
+
+Under-reach (dataflow.py has the policy): donation only taints plain
+dotted-name arguments; unresolvable callees donate nothing; one
+finding per donated name per function (the first read).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftlint import dataflow as df
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  register)
+
+RULE = "donation-safety"
+
+# the repo's step-factory seams: calling the RESULT donates these
+# positional args (training/steps.py, training/sparse_steps.py,
+# training/vm_steps.py all funnel through one make_* entry each)
+_FACTORIES: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "make_train_step": ((0, 1), ()),
+    "make_sparse_train_step": ((0, 1), ()),
+    "make_vm_train_step": ((0, 1), ()),
+}
+
+# assigning from these produces FRESH buffers — immune to alias taint
+_SNAPSHOT_CALLS = frozenset({"snapshot_state", "copy", "deepcopy",
+                             "device_get", "asarray", "array"})
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+
+Spec = Tuple[Tuple[int, ...], Tuple[str, ...]]  # (argnums, argnames)
+
+
+def _literal_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def jit_donate_spec(call: ast.Call) -> Optional[Spec]:
+    """The donation spec of a `jit(..., donate_argnums=...)` /
+    `functools.partial(jax.jit, donate_argnums=...)` call, or None."""
+    name = call_name(call)
+    if name == "partial":
+        if not (call.args and call_name_of(call.args[0]) in _JIT_NAMES):
+            return None
+    elif name not in _JIT_NAMES:
+        return None
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            argnums = _literal_ints(kw.value) or ()
+        elif kw.arg == "donate_argnames":
+            argnames = _literal_strs(kw.value) or ()
+    if argnums or argnames:
+        return (argnums, argnames)
+    return None
+
+
+def call_name_of(node: ast.AST) -> str:
+    """Trailing name of a Name/Attribute (non-call) expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _donating_value_spec(value: ast.AST) -> Optional[Spec]:
+    """Spec when `value` evaluates to a donating callable: a
+    jit-with-donate call or a step-factory call."""
+    if not isinstance(value, ast.Call):
+        return None
+    spec = jit_donate_spec(value)
+    if spec is not None:
+        return spec
+    if isinstance(value.func, ast.Call):
+        # functools.partial(jax.jit, donate_argnums=...)(f)
+        spec = jit_donate_spec(value.func)
+        if spec is not None:
+            return spec
+    return _FACTORIES.get(call_name(value))
+
+
+class _FileDonors:
+    """File-level donor tables built in one pre-pass: decorated defs,
+    module-scope donor names, and per-class `self.X` donor attrs."""
+
+    def __init__(self, ctx: FileContext):
+        self.defs: Dict[str, Spec] = {}
+        self.module_names: Dict[str, Spec] = {}
+        self.class_attrs: Dict[Tuple[str, str], Spec] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        spec = jit_donate_spec(dec)
+                        if spec is not None:
+                            self.defs[node.name] = spec
+            elif isinstance(node, ast.ClassDef):
+                for n in ast.walk(node):
+                    if not (isinstance(n, ast.Assign)
+                            and isinstance(n.value, ast.Call)):
+                        continue
+                    spec = _donating_value_spec(n.value)
+                    if spec is None:
+                        continue
+                    for t in n.targets:
+                        d = df.dotted(t)
+                        if d.startswith("self."):
+                            self.class_attrs[(node.name, d)] = spec
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                spec = _donating_value_spec(stmt.value)
+                if spec is not None:
+                    for t in stmt.targets:
+                        d = df.dotted(t)
+                        if d:
+                            self.module_names[d] = spec
+
+
+# state facts (per dotted name):
+#   ("donor", spec)          name is a donating callable
+#   ("donated", callee, ln)  name's buffers were donated at line ln
+#   ("snap",)                fresh buffers (snapshot/copy result)
+#   ("alias", names)         may refer to the same object as `names`
+
+
+class _Flow(df.FlowVisitor):
+    def __init__(self, ctx: FileContext, fn: ast.AST, cls: str,
+                 donors: _FileDonors, findings: List[Finding]):
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.donors = donors
+        self.findings = findings
+        self.qualname = f"{cls}.{fn.name}" if cls else fn.name
+        # one finding per (name, donation site) — the loop fixpoint
+        # pass must not double-report
+        self.flagged = set()
+
+    def join_states(self, a, b):
+        out = dict(b)
+        for name, fact in a.items():
+            other = out.get(name)
+            if other is None or other == fact:
+                out[name] = fact
+            elif fact[0] == "donated":
+                out[name] = fact  # donated-on-either-path stays donated
+            elif other[0] == "donated":
+                pass
+            else:
+                out[name] = fact
+        return out
+
+    # --- donation machinery ---
+
+    def _callee_spec(self, func: ast.AST, state) -> Optional[Spec]:
+        d = df.dotted(func)
+        if d:
+            fact = state.get(d)
+            if fact is not None and fact[0] == "donor":
+                return fact[1]
+            if d in self.donors.defs or d in self.donors.module_names:
+                return self.donors.defs.get(d) \
+                    or self.donors.module_names.get(d)
+            if self.cls and (self.cls, d) in self.donors.class_attrs:
+                return self.donors.class_attrs[(self.cls, d)]
+        if isinstance(func, ast.Call):
+            return jit_donate_spec(func)
+        return None
+
+    def _taint(self, name: str, callee: str, line: int, state,
+               via_alias: bool = False) -> None:
+        fact = state.get(name)
+        state[name] = ("donated", callee, line, via_alias)
+        # alias closure (one level, both directions): `b = a` then
+        # donate(a) poisons b; donate(b) poisons a
+        for other, ofact in list(state.items()):
+            if other == name or ofact is None:
+                continue
+            if ofact[0] == "alias" and any(
+                    df.is_name_or_prefix(name, m) or m == name
+                    for m in ofact[1]):
+                state[other] = ("donated", callee, line, True)
+        if fact is not None and fact[0] == "alias":
+            for m in fact[1]:
+                mfact = state.get(m)
+                if mfact is None or mfact[0] not in ("snap", "donated"):
+                    state[m] = ("donated", callee, line, True)
+
+    def _apply_calls(self, stmt: ast.AST, state) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = self._callee_spec(node.func, state)
+            if spec is None:
+                continue
+            callee = df.dotted(node.func) or call_name(node) or "jit"
+            argnums, argnames = spec
+            for i, a in enumerate(node.args):
+                if i in argnums:
+                    d = df.dotted(a)
+                    if d:
+                        self._taint(d, callee, node.lineno, state)
+            for kw in node.keywords:
+                if kw.arg in argnames:
+                    d = df.dotted(kw.value)
+                    if d:
+                        self._taint(d, callee, kw.value.lineno, state)
+
+    def _flag_reads(self, node: ast.AST, state) -> None:
+        for read, rnode in df.reads(node):
+            for name, fact in list(state.items()):
+                if fact[0] != "donated":
+                    continue
+                if df.is_name_or_prefix(read, name):
+                    state.pop(name, None)  # one finding per donation
+                    if (name, fact[2]) in self.flagged:
+                        continue
+                    self.flagged.add((name, fact[2]))
+                    via = " through an alias" if fact[3] else ""
+                    self.findings.append(Finding(
+                        rule=RULE, path=self.ctx.rel,
+                        line=getattr(rnode, "lineno",
+                                     getattr(node, "lineno", 0)),
+                        symbol=self.qualname,
+                        detail=f"donated at line {fact[2]}",
+                        message=(
+                            f"`{name}` is read after being donated"
+                            f"{via} to `{fact[1]}(...)` — donated "
+                            "buffers are deleted by the callee; rebind "
+                            "the name from the step's result, or "
+                            "snapshot (snapshot_state / jnp.copy) "
+                            "BEFORE the donating call")))
+
+    # --- engine hooks ---
+
+    def on_expr(self, expr, state):
+        self._flag_reads(expr, state)
+        self._apply_calls(expr, state)
+
+    def on_stmt(self, stmt, state):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else ([stmt.target] if stmt.value is not None else [])
+            if value is not None:
+                self._flag_reads(value, state)
+                self._apply_calls(value, state)
+            for t in targets:
+                # a subscript/attribute STORE does not read the base's
+                # buffers; only flag reads inside its index expression
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Subscript):
+                        self._flag_reads(sub.slice, state)
+            names = [d for t in targets for d in df.bound_names(t)]
+            for d in names:
+                state.pop(d, None)
+            if value is None or not names:
+                return
+            fact = self._value_fact(value, state)
+            if fact is not None:
+                for d in names:
+                    state[d] = fact
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._flag_reads(stmt.target, state)
+            self._flag_reads(stmt.value, state)
+            self._apply_calls(stmt.value, state)
+            for d in df.bound_names(stmt.target):
+                state.pop(d, None)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                d = df.dotted(t)
+                if d:
+                    state.pop(d, None)
+            return
+        # Expr / Return / Raise / Assert / anything else: reads + calls
+        self._flag_reads(stmt, state)
+        self._apply_calls(stmt, state)
+
+    def _value_fact(self, value: ast.AST, state) -> Optional[tuple]:
+        """The fact the assigned name(s) should carry for this RHS."""
+        if isinstance(value, ast.Call):
+            spec = _donating_value_spec(value)
+            if spec is not None:
+                return ("donor", spec)
+            if call_name(value) in _SNAPSHOT_CALLS:
+                return ("snap",)
+            return None
+        d = df.dotted(value)
+        if d:
+            # donor aliasing: `step = self._train_step` keeps the spec
+            fact = state.get(d)
+            if fact is not None and fact[0] == "donor":
+                return fact
+            if d in self.donors.defs:
+                return ("donor", self.donors.defs[d])
+            if self.cls and (self.cls, d) in self.donors.class_attrs:
+                return ("donor", self.donors.class_attrs[(self.cls, d)])
+            return ("alias", (d,))
+        if isinstance(value, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+            names = tuple(sorted({r for r, _n in df.reads(value)}))
+            if names:
+                return ("alias", names)
+        if isinstance(value, ast.IfExp):
+            a = self._value_fact(value.body, state)
+            b = self._value_fact(value.orelse, state)
+            return a or b
+        return None
+
+    def on_nested_def(self, node, state):
+        # closure capture: a nested def/lambda reading a donated name
+        # will observe deleted buffers whenever it eventually runs
+        bound = {a.arg for a in getattr(node.args, "args", ())} \
+            if hasattr(node, "args") else set()
+        for read, rnode in df.reads(node):
+            root = read.split(".", 1)[0]
+            if root in bound:
+                continue
+            for name, fact in list(state.items()):
+                if fact[0] == "donated" \
+                        and df.is_name_or_prefix(read, name):
+                    state.pop(name, None)
+                    if (name, fact[2]) in self.flagged:
+                        continue
+                    self.flagged.add((name, fact[2]))
+                    self.findings.append(Finding(
+                        rule=RULE, path=self.ctx.rel,
+                        line=getattr(rnode, "lineno", node.lineno),
+                        symbol=self.qualname,
+                        detail=f"donated at line {fact[2]}",
+                        message=(
+                            f"`{name}` is captured by a nested "
+                            f"function after being donated to "
+                            f"`{fact[1]}(...)` — the closure will read "
+                            "deleted buffers; snapshot before the "
+                            "donating call")))
+
+
+@register
+class DonationSafetyRule(Rule):
+    name = RULE
+    description = ("a name read/returned/captured after being passed "
+                   "to a donating call (jit donate_argnums, the "
+                   "make_train_step seams) — reassignment kills the "
+                   "taint, snapshot_state results are sanctioned")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        donors = _FileDonors(ctx)
+        for fn, cls in df.iter_functions(ctx.tree):
+            df.run_flow(fn, _Flow(ctx, fn, cls, donors, findings))
+        return findings

@@ -1,0 +1,446 @@
+"""resource-leak: an acquire whose release can be skipped.
+
+PR 6's review found the traced server leaking request spans: every
+exception between `start_trace()` and `root.end()` left an un-ended
+span in the live-span table forever, polluting each watchdog stall
+dump with phantom requests. The serving layer now releases on its
+error paths (server.py's `except BaseException: ... .end(); raise`
+blocks); this rule fences the class so the next acquire/release pair
+added to the repo gets the same treatment mechanically.
+
+Paired protocols are registered in ONE table (`PROTOCOLS`): tracer /
+telemetry spans (`span()`/`start_span()`/`start_trace()` →
+`.stop()`/`.end()`), thread lifecycles (`Thread()` + `.start()` →
+`.join()`, daemon threads sanctioned), server/socket lifecycles
+(`*Server()`/`socket()` + `.start()` → `.close()`/`.stop()`/...), the
+submit/wait barrier discipline (an owned writer/executor's first
+`.submit()` → `.wait()`/`.close()`/`.shutdown()`), and bare
+`lock.acquire()` → `lock.release()`.
+
+Two checks over the dataflow core's per-path state:
+
+  - EXIT LEAK: a path reaches `return` / falls off the end while a
+    tracked name is still held — acquire with no release on that path.
+  - ERROR PATH (span protocols only — the PR-6 class): a statement
+    that can raise (any call) executes while a span is held and no
+    enclosing `try` releases it in a `finally` or an except handler —
+    the success-path release exists but an exception skips it. Flagged
+    at the release site's protocol, reported at the acquire.
+
+Releases are credited generously (under-reach, dataflow.py policy): a
+release under ANY branch counts, ownership transfers clear the fact
+(the name returned / yielded / passed as an argument / stored into a
+container or attribute — whoever received it owns the release), `with`
+-managed names are never held, and only plain local names are tracked
+(`self._writer.submit(...)` is the owning object's lifecycle, not this
+function's). `test_*` functions are exempt from the ERROR-PATH check
+only: a failing test already fails loudly and pytest owns teardown —
+but a test that never releases at all still gets the exit-leak
+finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint import dataflow as df
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  register)
+
+RULE = "resource-leak"
+
+
+@dataclasses.dataclass(frozen=True)
+class Protocol:
+    key: str                 # short id used in messages
+    kind: str                # "call" (result held) | "ctor" | "method"
+    acquire: frozenset       # call names that acquire
+    release: frozenset       # methods on the held name that release
+    error_path: bool = False  # also run the PR-6 raise-window check
+    gate: str = ""           # ctor: held only once this method is called
+    # ctor kwargs that waive tracking entirely (daemon threads are
+    # designed never to be joined)
+    sanction_kwargs: frozenset = frozenset()
+    # method-kind: acquire only arms on receivers CONSTRUCTED in this
+    # function (a borrowed writer's lifecycle belongs to its owner);
+    # False for lock.acquire — the acquire itself creates the
+    # obligation regardless of who owns the lock object
+    needs_owned: bool = True
+
+
+PROTOCOLS: Tuple[Protocol, ...] = (
+    Protocol("span", "call",
+             frozenset({"span", "start_span", "start_trace"}),
+             frozenset({"stop", "end", "close", "cancel"}),
+             error_path=True),
+    Protocol("thread", "ctor", frozenset({"Thread", "Timer"}),
+             frozenset({"join"}), gate="start",
+             sanction_kwargs=frozenset({"daemon"})),
+    Protocol("server", "ctor", frozenset({"socket"}),
+             frozenset({"close", "stop", "shutdown", "server_close",
+                        "join"}), gate="start"),
+    Protocol("submit-barrier", "method", frozenset({"submit"}),
+             frozenset({"wait", "close", "join", "shutdown",
+                        "drain_quiet", "stop", "result"})),
+    Protocol("lock", "method", frozenset({"acquire"}),
+             frozenset({"release"}), needs_owned=False),
+)
+
+# trailing call names that are protocol vocabulary: a statement whose
+# calls are ALL acquires/releases is not a "risky" raise window (the
+# shipped idiom opens two spans back-to-back before the try)
+_PROTOCOL_CALL_NAMES = frozenset().union(
+    *(p.acquire | p.release for p in PROTOCOLS),
+    *({p.gate} for p in PROTOCOLS if p.gate))
+
+# builtins/clock reads that do not realistically raise — span-attribute
+# computation (`n=len(lines)`, `step=int(self.step_num)`) must not turn
+# every acquire statement into its own "raise window"
+_SAFE_CALL_NAMES = frozenset({
+    "len", "int", "float", "bool", "str", "repr", "round", "min",
+    "max", "abs", "isinstance", "issubclass", "hasattr", "getattr",
+    "id", "type", "tuple", "list", "dict", "set", "sorted",
+    "monotonic", "perf_counter", "time",
+})
+
+_BY_CALL: Dict[str, Protocol] = {}
+for _p in PROTOCOLS:
+    if _p.kind in ("call", "ctor"):
+        for _a in _p.acquire:
+            _BY_CALL[_a] = _p
+_BY_METHOD: Dict[str, Protocol] = {}
+for _p in PROTOCOLS:
+    if _p.kind == "method":
+        for _a in _p.acquire:
+            _BY_METHOD[_a] = _p
+
+# `span` is container vocabulary too (re.Match.span()); only credit it
+# as an acquire when the receiver looks like a telemetry/trace object
+_SPAN_RECEIVER_HINTS = ("tele", "trace", "obs", "span")
+
+
+def _ctor_protocol(call: ast.Call) -> Optional[Protocol]:
+    name = call_name(call)
+    p = _BY_CALL.get(name)
+    if p is not None and p.kind == "ctor":
+        return p
+    if name.endswith("Server"):
+        return _BY_CALL["socket"]  # the server/socket lifecycle entry
+    return None
+
+
+def _call_protocol(call: ast.Call) -> Optional[Protocol]:
+    name = call_name(call)
+    p = _BY_CALL.get(name)
+    if p is None or p.kind != "call":
+        return None
+    if name == "span" and isinstance(call.func, ast.Attribute):
+        recv = df.dotted(call.func.value).lower()
+        if recv and not any(h in recv for h in _SPAN_RECEIVER_HINTS):
+            return None
+    return p
+
+
+# state fact per plain local name:
+#   ("held", proto, line, desc)     acquired, release outstanding
+#   ("pending", proto, line, desc)  ctor'd, not gate-started yet
+#   ("owned", line)                 constructed here (method-kind arm)
+#   ("cm",)                         with-managed — never tracked
+
+
+class _Flow(df.FlowVisitor):
+    def __init__(self, ctx: FileContext, fn: ast.AST, cls: str,
+                 findings: List[Finding]):
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.findings = findings
+        self.qualname = f"{cls}.{fn.name}" if cls else fn.name
+        self.is_test = fn.name.startswith("test_")
+        # names with a pending error-path candidate: name -> risky line
+        self.candidates: Dict[str, int] = {}
+        self.flagged: Set[Tuple[str, int, str]] = set()
+        # stack of name-sets protected by an enclosing try whose
+        # finally/handlers release them
+        self.protection: List[Set[str]] = []
+        self.in_finally = 0
+
+    def join_states(self, a, b):
+        # a name held on ONE side only was released (or never acquired)
+        # on the other — credit the release, keep the intersection
+        return {k: v for k, v in a.items()
+                if k in b and (b[k] == v or b[k][0] == v[0])}
+
+    # --- findings ---
+
+    def _emit(self, kind: str, name: str, fact, line_hint: int) -> None:
+        proto, aline, desc = fact[1], fact[2], fact[3]
+        key = (name, aline, kind)
+        if key in self.flagged:
+            return
+        self.flagged.add(key)
+        rel = "/".join(sorted(proto.release))
+        if kind == "exit":
+            msg = (f"`{name}` (= {desc}) is not released on every "
+                   f"path — the function can exit without "
+                   f"`{name}.{rel}()`; release in a finally block or "
+                   "use the context-manager form")
+        else:
+            msg = (f"`{name}` (= {desc}) is released only on the "
+                   "success path — an exception in between leaks it "
+                   "(the PR-6 leaked-span class); release in a "
+                   "finally block, an except handler, or use the "
+                   "context-manager form")
+        self.findings.append(Finding(
+            rule=RULE, path=self.ctx.rel, line=aline,
+            symbol=self.qualname,
+            detail=f"path exits near line {line_hint}" if kind == "exit"
+            else f"can raise at line {line_hint}",
+            message=msg))
+
+    # --- acquire / release / escape ---
+
+    def _acquire_from_assign(self, names: List[str], value: ast.AST,
+                             state) -> bool:
+        """Returns True when the RHS established a tracked fact."""
+        if isinstance(value, ast.IfExp):
+            return (self._acquire_from_assign(names, value.body, state)
+                    or self._acquire_from_assign(names, value.orelse,
+                                                 state))
+        if not isinstance(value, ast.Call) or len(names) != 1:
+            return False
+        name = names[0]
+        if "." in name:
+            return False
+        ctor = _ctor_protocol(value)
+        if ctor is not None:
+            for kw in value.keywords:
+                if kw.arg in ctor.sanction_kwargs:
+                    return False
+            desc = f"{call_name(value)}(...)"
+            if ctor.gate:
+                state[name] = ("pending", ctor, value.lineno, desc)
+            else:
+                state[name] = ("held", ctor, value.lineno, desc)
+            return True
+        p = _call_protocol(value)
+        if p is not None:
+            desc = f"{df.dotted(value.func) or call_name(value)}(...)"
+            state[name] = ("held", p, value.lineno, desc)
+            return True
+        cn = call_name(value)
+        if cn and cn[0].isupper():
+            # constructed (and therefore owned) here: arms the
+            # method-kind protocols (an owned writer's .submit())
+            state[name] = ("owned", value.lineno)
+            return True
+        return False
+
+    def _method_call(self, name: str, method: str, call: ast.Call,
+                     state) -> None:
+        fact = state.get(name)
+        if fact is None:
+            proto = _BY_METHOD.get(method)
+            if proto is not None and not proto.needs_owned:
+                # lock.acquire(): the acquire itself creates the
+                # release obligation, even on a borrowed object
+                state[name] = ("held", proto, call.lineno,
+                               f".{method}(...)")
+            return
+        if fact[0] == "cm":
+            return
+        if fact[0] in ("held", "pending"):
+            proto = fact[1]
+            if method in proto.release:
+                cand = self.candidates.pop(name, None)
+                if cand is not None and fact[0] == "held" \
+                        and proto.error_path and not self.is_test:
+                    self._emit("error", name, fact, cand)
+                state.pop(name, None)
+                return
+            if fact[0] == "pending" and method == proto.gate:
+                state[name] = ("held", proto, fact[2], fact[3])
+            return
+        if fact[0] == "owned":
+            proto = _BY_METHOD.get(method)
+            if proto is not None:
+                state[name] = ("held", proto, call.lineno,
+                               f".{method}(...)")
+
+    def _escapes(self, node: ast.AST, state) -> None:
+        """Ownership transfers: the name used as an argument, RHS
+        alias, container element, returned/yielded value, or stored
+        to an attribute/subscript."""
+
+        def clear(expr: ast.AST) -> None:
+            for d, _n in df.reads(expr):
+                base = d.split(".", 1)[0]
+                if base in state:
+                    state.pop(base, None)
+                    self.candidates.pop(base, None)
+
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                for a in n.args:
+                    clear(a)
+                for kw in n.keywords:
+                    clear(kw.value)
+            elif isinstance(n, (ast.Yield, ast.YieldFrom)) \
+                    and n.value is not None:
+                clear(n.value)  # the consumer owns the yielded value
+
+    # --- engine hooks ---
+
+    def on_bind(self, target, state, source, value=None):
+        names = df.bound_names(target)
+        for n in names:
+            state.pop(n, None)
+            self.candidates.pop(n, None)
+        if source == "with":
+            for n in names:
+                if "." not in n:
+                    state[n] = ("cm",)
+
+    def on_with(self, stmt, state):
+        # `with x:` — the context manager owns x's cleanup now
+        for item in stmt.items:
+            d = df.dotted(item.context_expr)
+            if d and "." not in d:
+                state.pop(d, None)
+                self.candidates.pop(d, None)
+        return None
+
+    def on_try(self, stmt, state):
+        protected: Set[str] = set()
+        for region in ([stmt.finalbody]
+                       + [h.body for h in stmt.handlers]):
+            for n in region:
+                for call in (c for c in ast.walk(n)
+                             if isinstance(c, ast.Call)):
+                    if isinstance(call.func, ast.Attribute):
+                        base = df.dotted(call.func.value)
+                        if base and "." not in base:
+                            protected.add(base)
+        self.protection.append(protected)
+        return protected
+
+    def after_try(self, token, state):
+        self.protection.pop()
+
+    def enter_finally(self):
+        self.in_finally += 1
+
+    def exit_finally(self):
+        self.in_finally -= 1
+
+    def _protected(self, name: str) -> bool:
+        return any(name in s for s in self.protection)
+
+    def on_expr(self, expr, state):
+        for call in (n for n in ast.walk(expr)
+                     if isinstance(n, ast.Call)):
+            if isinstance(call.func, ast.Attribute):
+                base = df.dotted(call.func.value)
+                if base and "." not in base:
+                    self._method_call(base, call.func.attr, call, state)
+
+    def on_stmt(self, stmt, state):
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._escapes(stmt, state)
+                for d, _n in df.reads(stmt.value):
+                    base = d.split(".", 1)[0]
+                    state.pop(base, None)
+                    self.candidates.pop(base, None)
+            self._check_exit(stmt.lineno, state)
+            return
+        if isinstance(stmt, ast.Raise):
+            # an explicit raise is a deliberate error path; the
+            # enclosing caller's handler owns cleanup (under-reach)
+            return
+
+        # releases / gates / method-kind acquires, anywhere in the stmt
+        self.on_expr(stmt, state)
+        self._escapes(stmt, state)
+
+        if isinstance(stmt, ast.Assign):
+            names = [d for t in stmt.targets for d in df.bound_names(t)]
+            for n in names:
+                state.pop(n, None)
+                self.candidates.pop(n, None)
+            # ownership transfers through the RHS: a store THROUGH an
+            # attribute/subscript, a plain alias (`handle = sp`), or a
+            # container literal (`spans = [sp]`) — whoever can reach
+            # the value now owns the release (under-reach)
+            if any(df.mutated_bases(t) for t in stmt.targets) \
+                    or isinstance(stmt.value,
+                                  (ast.Name, ast.Attribute, ast.Dict,
+                                   ast.List, ast.Tuple, ast.Set,
+                                   ast.Starred, ast.IfExp)):
+                for d, _n in df.reads(stmt.value):
+                    base = d.split(".", 1)[0]
+                    state.pop(base, None)
+                    self.candidates.pop(base, None)
+            self._acquire_from_assign(names, stmt.value, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            names = df.bound_names(stmt.target)
+            for n in names:
+                state.pop(n, None)
+            self._acquire_from_assign(names, stmt.value, state)
+
+        # the PR-6 error-path window: something that can raise runs
+        # while a span is held and no enclosing try releases it
+        if not self.is_test and self.in_finally == 0 \
+                and self._is_risky(stmt):
+            for name, fact in list(state.items()):
+                if fact[0] == "held" and fact[1].error_path \
+                        and not self._protected(name) \
+                        and name not in self.candidates \
+                        and fact[2] != stmt.lineno:
+                    self.candidates[name] = stmt.lineno
+
+    def _is_risky(self, stmt: ast.AST) -> bool:
+        """Can this statement realistically raise while spans are
+        held? Calls that are themselves protocol vocabulary (opening a
+        sibling span, starting a thread) don't count — the shipped
+        idiom opens two spans back-to-back before its try block."""
+        if not df.stmt_may_raise(stmt):
+            return False
+        saw_call = False
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Raise, ast.Assert)):
+                return True
+            if isinstance(n, ast.Call):
+                saw_call = True
+                cn = call_name(n)
+                if cn not in _PROTOCOL_CALL_NAMES \
+                        and cn not in _SAFE_CALL_NAMES:
+                    return True
+        return not saw_call
+
+    def _check_exit(self, line: int, state) -> None:
+        for name, fact in state.items():
+            if fact[0] == "held":
+                self._emit("exit", name, fact, line)
+
+    def at_exit(self, fn, state):
+        self._check_exit(getattr(fn, "end_lineno", fn.lineno), state)
+
+
+@register
+class ResourceLeakRule(Rule):
+    name = RULE
+    description = ("paired acquire/release protocols (spans, threads, "
+                   "servers, submit/wait, lock.acquire) where a path "
+                   "exits without the release, or — for spans — an "
+                   "exception window skips it (the PR-6 leaked-span "
+                   "class); try/finally, except-handler and "
+                   "context-manager releases are credited")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn, cls in df.iter_functions(ctx.tree):
+            df.run_flow(fn, _Flow(ctx, fn, cls, findings))
+        return findings

@@ -1,0 +1,310 @@
+"""thread-handoff: an object mutated after being handed to another
+thread.
+
+The PR-4 MicroBatcher stop/start race was this shape: a request object
+was enqueued for the batcher thread, then the submitting thread kept
+mutating it — two owners, no lock, and pytest catches it one run in a
+thousand. lock-discipline fences `self.*` attributes; this rule
+generalizes the discipline to FLOWED values using the dataflow core's
+escape lattice (tools/graftlint/dataflow.py): a local name ESCAPES
+when it is
+
+  - passed to `Thread(target=..., args=(...))` (the new thread closes
+    over it),
+  - `q.put(...)` / `q.put_nowait(...)` (the consumer dequeues it),
+  - `executor.submit(f, x)` (the worker receives it),
+  - `channel.send(...)` (the SpanChannel-style side channel), or
+  - stored to `self.<attr>` in a LOCK-OWNING class (another thread can
+    reach it through the shared object);
+
+and a later mutation of the escaped name by the origin thread —
+attribute/subscript store, augmented assignment, or a mutator-method
+call (`append`, `update`, `clear`, ...) — OUTSIDE a `with
+self._lock:`-style block is the static shape of the race. Rebinding
+the name kills the escape (building a fresh item per loop iteration is
+the idiom, not a bug); mutating BEFORE the handoff is fine (that is
+the fix this rule suggests).
+
+Sub-check ("never raise from the monitor thread", the watchdog/monitor
+discipline — ARCHITECTURE.md): a locally-defined function handed to
+`Thread(target=...)` where either the thread's `name=` or the
+function's own name marks it as a monitor/watchdog loop must not
+contain a bare `raise` outside any try/except — an exception kills the
+monitor silently and the run loses its liveness detection exactly when
+it hangs. Record the failure (telemetry event, sticky error) instead.
+
+Under-reach: only plain local names are tracked (`self` handed as a
+bound-method target is the class's own lock-discipline problem, not a
+flowed value); unresolvable mutations drop the fact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from tools.graftlint import dataflow as df
+from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
+                                  is_self_attr, register)
+from tools.graftlint.rules.lock_discipline import (_INIT_METHODS,
+                                                   _MUTATORS,
+                                                   _is_lock_ctor,
+                                                   _lockish_with_item)
+
+RULE = "thread-handoff"
+
+_QUEUE_METHODS = frozenset({"put", "put_nowait"})
+_SUBMIT_METHODS = frozenset({"submit"})
+_CHANNEL_METHODS = frozenset({"send"})
+_MONITORISH = ("monitor", "watchdog", "watcher")
+
+
+def _lock_owning_classes(tree: ast.AST) -> Set[str]:
+    """Class names that install a threading lock anywhere in their
+    body (the lock-discipline scope rule: no lock, no cross-thread
+    mutation contract to enforce)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for n in ast.walk(node):
+            val = getattr(n, "value", None)
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) \
+                    and val is not None and _is_lock_ctor(val):
+                out.add(node.name)
+                break
+    return out
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return call_name(call) in ("Thread", "Timer")
+
+
+def _thread_name_kwarg(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return ""
+
+
+def _unguarded_raises(fn: ast.AST) -> List[ast.Raise]:
+    """`raise` statements in `fn` not lexically inside a try that has
+    except handlers (those may be deliberate signal-and-catch)."""
+    out: List[ast.Raise] = []
+
+    def walk(node: ast.AST, guarded: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Raise) and not guarded:
+                out.append(child)
+            if isinstance(child, ast.Try) and child.handlers:
+                for stmt in child.body:
+                    walk(stmt, True)
+                for h in child.handlers:
+                    walk(h, guarded)
+                for stmt in child.orelse + child.finalbody:
+                    walk(stmt, guarded)
+                continue
+            walk(child, guarded)
+
+    walk(fn, False)
+    return out
+
+
+# state fact per local name: ("escaped", how, line)
+
+
+class _Flow(df.FlowVisitor):
+    def __init__(self, ctx: FileContext, fn: ast.AST, cls: str,
+                 lock_classes: Set[str], findings: List[Finding]):
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.owns_lock = cls in lock_classes
+        self.findings = findings
+        self.qualname = f"{cls}.{fn.name}" if cls else fn.name
+        self.lock_depth = 0
+        self.local_defs: Dict[str, ast.AST] = {}
+        self.flagged: Set[str] = set()
+        self.monitor_flagged: Set[str] = set()
+
+    def join_states(self, a, b):
+        out = dict(b)
+        out.update(a)  # escaped-on-either-path stays escaped
+        return out
+
+    # --- escapes ---
+
+    def _escape(self, name: str, how: str, line: int, state) -> None:
+        if "." in name or name == "self":
+            return  # flowed VALUES only; self.* is lock-discipline's job
+        state.setdefault(name, ("escaped", how, line))
+
+    def _check_monitor_target(self, call: ast.Call) -> None:
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = df.dotted(kw.value)
+        if not target or target not in self.local_defs:
+            return
+        tname = _thread_name_kwarg(call).lower()
+        monitorish = any(m in tname for m in _MONITORISH) \
+            or any(m in target.lower() for m in _MONITORISH)
+        if not monitorish or target in self.monitor_flagged:
+            return
+        for r in _unguarded_raises(self.local_defs[target]):
+            self.monitor_flagged.add(target)
+            self.findings.append(Finding(
+                rule=RULE, path=self.ctx.rel, line=r.lineno,
+                symbol=self.qualname,
+                detail=f"thread created at line {call.lineno}",
+                message=(f"`{target}` runs on a monitor/watchdog "
+                         "thread and raises — an unhandled exception "
+                         "kills the monitor silently, losing liveness "
+                         "detection exactly when the run hangs; "
+                         "record the failure (telemetry event, sticky "
+                         "error surfaced at the next beat/poll) "
+                         "instead of raising")))
+            break
+
+    def _process_calls(self, node: ast.AST, state) -> None:
+        for call in (n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)):
+            if _is_thread_ctor(call):
+                self._check_monitor_target(call)
+                for _kw, d, anode in df.arg_names(call):
+                    self._escape(d, "Thread(...)", anode.lineno, state)
+                # args=(x, y) / kwargs={...}: the tuple is a literal,
+                # the names INSIDE it are what escape
+                for kw in call.keywords:
+                    if isinstance(kw.value, (ast.Tuple, ast.List,
+                                             ast.Dict)):
+                        for d, rnode in df.reads(kw.value):
+                            self._escape(d.split(".", 1)[0],
+                                         "Thread(...)", rnode.lineno,
+                                         state)
+                continue
+            if isinstance(call.func, ast.Attribute):
+                m = call.func.attr
+                how = None
+                if m in _QUEUE_METHODS:
+                    how = f".{m}(...)"
+                elif m in _SUBMIT_METHODS:
+                    how = ".submit(...)"
+                elif m in _CHANNEL_METHODS:
+                    how = ".send(...)"
+                if how is not None:
+                    for _kw, d, anode in df.arg_names(call):
+                        self._escape(d, how, anode.lineno, state)
+
+    # --- mutations ---
+
+    def _flag_mutation(self, name: str, line: int, state) -> None:
+        fact = state.get(name)
+        if fact is None or fact[0] != "escaped" \
+                or self.lock_depth > 0:
+            return
+        # one finding per (name, escape site): the loop fixpoint pass
+        # re-executes bodies, and a rebind+re-escape at the SAME site
+        # must not double-report
+        if (name, fact[2]) in self.flagged:
+            return
+        self.flagged.add((name, fact[2]))
+        self.findings.append(Finding(
+            rule=RULE, path=self.ctx.rel, line=line,
+            symbol=self.qualname,
+            detail=f"escaped via {fact[1]} at line {fact[2]}",
+            message=(f"`{name}` is mutated after being handed to "
+                     f"another thread via {fact[1]} — the receiving "
+                     "thread may already own it; mutate before the "
+                     "handoff, hand off a copy, or take the class "
+                     "lock at both sites")))
+
+    def _process_mutations(self, stmt: ast.AST, state) -> None:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for base in df.mutated_bases(t):
+                if "." not in base:
+                    self._flag_mutation(base, stmt.lineno, state)
+            # `lst += [...]` on a bare name: for mutable values this is
+            # an in-place extend of an object the consumer may already
+            # own — the PR-4 race shape (for immutables it is a rebind,
+            # and the kill below ends tracking either way)
+            if isinstance(stmt, ast.AugAssign) \
+                    and isinstance(t, ast.Name):
+                self._flag_mutation(t.id, stmt.lineno, state)
+        for call in (n for n in ast.walk(stmt)
+                     if isinstance(n, ast.Call)):
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _MUTATORS:
+                base = df.dotted(call.func.value)
+                if base and "." not in base:
+                    self._flag_mutation(base, call.lineno, state)
+
+    # --- engine hooks ---
+
+    def on_with(self, stmt, state):
+        locked = any(_lockish_with_item(i) for i in stmt.items)
+        if locked:
+            self.lock_depth += 1
+        return locked
+
+    def after_with(self, token, state):
+        if token:
+            self.lock_depth -= 1
+
+    def on_expr(self, expr, state):
+        self._process_calls(expr, state)
+
+    def on_stmt(self, stmt, state):
+        self._process_mutations(stmt, state)
+        self._process_calls(stmt, state)
+        if isinstance(stmt, ast.Assign):
+            # attribute-store on a lock-owning class: the value is now
+            # reachable by every thread that can see `self`
+            # (construction methods exempt — single-threaded by the
+            # lock-discipline convention, nobody else sees self yet)
+            for t in stmt.targets:
+                if self.owns_lock and is_self_attr(t) is not None \
+                        and self.fn.name not in _INIT_METHODS:
+                    d = df.dotted(stmt.value)
+                    if d:
+                        self._escape(d, f"self.{is_self_attr(t)} = ...",
+                                     stmt.lineno, state)
+            for t in stmt.targets:
+                for name in df.bound_names(t):
+                    if "." not in name:
+                        state.pop(name, None)  # rebind kills the escape
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            # after an AugAssign the name may be rebound (immutables) —
+            # one report max, then tracking ends
+            for name in df.bound_names(stmt.target):
+                if "." not in name:
+                    state.pop(name, None)
+
+    def on_nested_def(self, node, state):
+        self.local_defs[node.name] = node
+
+
+@register
+class ThreadHandoffRule(Rule):
+    name = RULE
+    description = ("a value mutated after escaping to another thread "
+                   "(Thread/queue.put/executor.submit/channel.send/"
+                   "shared-attr store) without the class lock; plus "
+                   "the never-raise-from-monitor-thread discipline on "
+                   "escaped callables")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        lock_classes = _lock_owning_classes(ctx.tree)
+        for fn, cls in df.iter_functions(ctx.tree):
+            df.run_flow(fn, _Flow(ctx, fn, cls, lock_classes, findings))
+        return findings
