@@ -351,7 +351,8 @@ class Config:
     # ---- deterministic fault injection (code2vec_tpu/resilience/,
     # ISSUE 10): --faults <file-or-inline-json> arms the seeded
     # failpoint registry (sites: ckpt/write, infeed/produce,
-    # train/nan_loss, train/kill, serve/extract, dist/init).
+    # train/nan_loss, train/kill, serve/extract, serve/kill,
+    # dist/init).
     # Unset (default): every site is one attribute/None check, no
     # thread, no allocation. tools/chaos.py drives the scenarios.
     FAULTS: Optional[str] = None

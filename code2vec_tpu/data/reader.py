@@ -12,7 +12,14 @@ fields for eval/predict). TPU-first differences:
 - The fast path is pre-binarized int32 shards (data/binarize.py) read via
   np.memmap — CSV/string parsing on the host is the #1 throughput risk for
   the 8x target (SURVEY.md §8.3 step 2).
-- Shuffle is an index permutation per epoch, seeded for reproducibility.
+- Shuffle is a GLOBAL index permutation per epoch, seeded for
+  reproducibility; each host then takes its strided slice of the
+  permuted order. Host h's batch t is rows perm[h::H][tB:(t+1)B], so
+  the union across hosts at step t is the contiguous block
+  perm[H·tB : H·(t+1)B] — the global data order is a function of
+  (seed, epoch) ALONE, independent of the host count (ISSUE 13: an
+  elastically re-formed cohort replays the same global stream a
+  same-size uninterrupted run would).
 - `host_shard` / `num_host_shards` slice the example space for multi-host
   feeding (each host feeds its local devices; SURVEY.md §3.3 "Infeed").
 """
@@ -186,17 +193,21 @@ class C2VTextReader:
         return self._offsets
 
     def __iter__(self) -> Iterator[BatchTensors]:
-        offsets = self._line_offsets()[self.host_shard::
-                                       self.num_host_shards]
+        offsets = self._line_offsets()
+        # GLOBAL permutation first, host-shard slice second (ISSUE 13):
+        # the epoch's data order is fixed by (seed, epoch) before any
+        # host claims its rows, so a resize changes only how the one
+        # global stream is dealt out — not what the stream is
         order = np.arange(len(offsets))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(order)
             self._epoch += 1
+        mine = order[self.host_shard::self.num_host_shards]
         emitted = 0
         with open(self.path, "r", encoding="utf-8", errors="replace") as f:
-            for start in range(0, len(offsets), self.batch_size):
-                idx = order[start:start + self.batch_size]
+            for start in range(0, len(mine), self.batch_size):
+                idx = mine[start:start + self.batch_size]
                 batch_lines = []
                 for off in offsets[idx]:
                     f.seek(off)
@@ -277,12 +288,15 @@ class BinaryShardReader:
 
     def __iter__(self) -> Iterator[BatchTensors]:
         C = self.max_contexts
-        order = np.arange(self.host_shard, self.num_examples,
-                          self.num_host_shards)
+        # global permutation, then the host's strided slice — see
+        # C2VTextReader.__iter__ (the elastic-resume data-order
+        # contract is identical on the binary fast path)
+        order = np.arange(self.num_examples)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
             rng.shuffle(order)
             self._epoch += 1
+        order = order[self.host_shard::self.num_host_shards]
         emitted = 0
         for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]

@@ -325,14 +325,18 @@ class Code2VecModel(Code2VecModelBase):
         # auto-resume (ISSUE 10): the ONE shared epoch-offset
         # arithmetic (models/setup.py — the recovery contract both
         # heads must agree on)
-        from code2vec_tpu.models.setup import resume_epoch_offset
+        from code2vec_tpu.models.setup import (infeed_split,
+                                               resume_epoch_offset)
         completed_epochs = resume_epoch_offset(
             cfg, self.step_num, self._n_train_examples, self.log)
+        # per-host infeed split from the LIVE process set (ISSUE 13):
+        # a supervisor-re-formed cohort re-deals the same global
+        # stream over however many survivors joined this launch
+        host_shard, num_host_shards = infeed_split()
         reader = open_reader(
             cfg.data_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
             cfg.TRAIN_BATCH_SIZE, shuffle=True, seed=cfg.SEED,
-            host_shard=jax.process_index(),
-            num_host_shards=jax.process_count(),
+            host_shard=host_shard, num_host_shards=num_host_shards,
             epoch_offset=completed_epochs)
         self.log(f"starting training: dims={self.dims}, "
                  f"devices={len(jax.devices())}, mesh={self.mesh}")
@@ -527,6 +531,7 @@ class Code2VecModel(Code2VecModelBase):
                     # kick the save FIRST (async: returns after the
                     # snapshot) so eval below runs while the writer drains —
                     # boundary cost ~ max(eval, save tail), not save + eval
+                    self._save_epoch = epoch  # -> step topology record
                     self.save(cfg.save_path, block=False)
                     epoch_end_work = True
                 if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
@@ -852,6 +857,14 @@ class Code2VecModel(Code2VecModelBase):
                  # provenance only (no structural effect on restore)
                  "adv_rename_prob": self.config.ADV_RENAME_PROB,
                  "adv_rename_mode": self.config.ADV_RENAME_MODE}
+        # per-step save-time topology (ISSUE 13): epoch set by the
+        # train loop at boundary saves and CONSUMED here (reset to
+        # None so a later manual save at a further-trained step can't
+        # stamp a stale epoch that would make resume re-train it —
+        # epoch-less records fall back to the save-topology
+        # arithmetic, see models/setup.resume_epoch_offset)
+        topology = {"epoch": getattr(self, "_save_epoch", None)}
+        self._save_epoch = None
         # trace (--trace): the save's blocked window LINKS the step that
         # triggered it (the per-step trace the recorder keeps current),
         # and the writer thread parents its train/save_write span to
@@ -872,6 +885,7 @@ class Code2VecModel(Code2VecModelBase):
                 writer.submit(path, state, self.step_num, self.vocabs,
                               self.dims, extra_manifest=extra,
                               max_to_keep=self.config.MAX_TO_KEEP,
+                              topology=topology,
                               telemetry=self.telemetry,
                               tracer=self.tracer
                               if trace_span is not None else None,
@@ -886,7 +900,8 @@ class Code2VecModel(Code2VecModelBase):
                 ckpt.save_checkpoint(path, state, self.step_num,
                                      self.vocabs, self.dims,
                                      extra_manifest=extra,
-                                     max_to_keep=self.config.MAX_TO_KEEP)
+                                     max_to_keep=self.config.MAX_TO_KEEP,
+                                     topology=topology)
                 blocked_ms = blocked_span.stop()
                 # the sync save IS its own writer: total == blocked, and
                 # the commit event keeps telemetry_report's boundary

@@ -16,9 +16,23 @@ from code2vec_tpu.config import Config
 from code2vec_tpu.parallel.mesh import make_mesh
 
 
+def infeed_split() -> "tuple[int, int]":
+    """(host_shard, num_host_shards) for the train reader — re-derived
+    from the LIVE process set at every (re)launch via
+    `parallel/compat.cohort_world` (ISSUE 13). A cohort the supervisor
+    re-formed at N−1 gets an N−1-way split with no resize-specific
+    code in either model head; combined with the reader's GLOBAL
+    per-epoch permutation, the re-formed cohort consumes the same
+    global data stream a same-size uninterrupted run would."""
+    from code2vec_tpu.parallel.compat import cohort_world
+    return cohort_world()
+
+
 def build_mesh(cfg: Config, *, with_context_axis: bool = True):
     """The model's mesh (or None for a plain single-device run): all
-    axes from config, sized 1 when unused."""
+    axes from config, sized 1 when unused — `jax.devices()` is the
+    live-cohort device set, so an elastically re-formed cohort's mesh
+    rebuilds from the surviving processes (ISSUE 13)."""
     n_dev = len(jax.devices())
     model_axis = max(1, cfg.MESH_MODEL_AXIS)
     ctx_axis = max(1, cfg.MESH_CONTEXT_AXIS) if with_context_axis else 1
@@ -44,7 +58,14 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
       counts toward NUM_TRAIN_EPOCHS), so its horizon is the original
       run's epochs x steps-per-epoch and the resumed LR curve matches
       the uninterrupted run's at every absolute step (the chaos-parity
-      contract, schedule-agnostic);
+      contract, schedule-agnostic). Under an ELASTIC resume onto a
+      different cohort size (ISSUE 13) the horizon re-derives at the
+      NEW size (num_hosts = the live process count): the decayed
+      curve then matches an uninterrupted run AT THE NEW SIZE resumed
+      from the same step — the elastic parity bar — and deliberately
+      NOT the old topology's curve, whose step count no longer maps
+      to this run's steps (the chaos kill_resize acceptance pins
+      constant LR, where the distinction vanishes);
     - eval/predict-only runs take no optimizer steps, so horizon 1
       yields the right opt_state STRUCTURE.
     """
@@ -80,25 +101,57 @@ def build_optimizer(cfg: Config, count_examples_fn: Callable[[], int],
 def resume_epoch_offset(cfg: Config, step_num: int,
                         count_examples_fn: Callable[[], int],
                         log: Callable[[str], None]) -> int:
-    """Completed epochs to skip on --auto_resume (ISSUE 10): the
-    restored step count over the per-host steps-per-epoch (the same
-    ceil-div the reader's aligned batch count and the LR horizon use —
-    exact because saves only happen at epoch boundaries). A resumed
-    run then trains ONLY the remaining epochs, with the reader's
-    shuffle stream advanced to match; together with the step-keyed
-    rng in the train loops, recovery replays the uninterrupted
-    trajectory exactly (the chaos-parity acceptance). Plain --load +
-    --data keeps fine-tune semantics (a full NUM_TRAIN_EPOCHS more).
-    ONE definition for both model heads: this arithmetic is the
-    recovery contract, and hand-synced copies would drift."""
+    """Completed epochs to skip on --auto_resume (ISSUE 10; made
+    topology-independent by ISSUE 13). A resumed run trains ONLY the
+    remaining epochs, with the reader's shuffle stream advanced to
+    match; together with the step-keyed rng in the train loops,
+    recovery replays the uninterrupted trajectory exactly (the
+    chaos-parity acceptance). Plain --load + --data keeps fine-tune
+    semantics (a full NUM_TRAIN_EPOCHS more). ONE definition for both
+    model heads: this arithmetic is the recovery contract, and
+    hand-synced copies would drift.
+
+    Resolution order:
+    1. The restored step's save-time `topology.json` `epoch` field —
+       saves happen at epoch boundaries, so the record IS the answer,
+       exact across ANY resize history (a cohort re-formed at N−1 has
+       a different steps-per-epoch than the one that counted the
+       restored steps, and after several resizes the step count is a
+       mixed-topology sum no single division can unwind).
+    2. Its `num_processes` field: the restored step count over the
+       SAVE-TIME per-host steps-per-epoch (the same ceil-div the
+       reader's aligned batch count and the LR horizon use — exact
+       because saves only happen at epoch boundaries) — covers
+       same-run checkpoints written before the epoch field existed.
+    3. Pre-elastic checkpoints (no record): the current topology's
+       steps-per-epoch, the PR-10 behavior — exact whenever the
+       topology never changed, which is the only history such a
+       checkpoint can have."""
     if not (cfg.AUTO_RESUME and step_num > 0):
         return 0
     from code2vec_tpu.data.reader import steps_per_epoch
+    topo = None
+    if cfg.is_loading and cfg.load_path:
+        from code2vec_tpu.training import checkpoint as ckpt_mod
+        topo = ckpt_mod.load_step_topology(cfg.load_path, step_num)
+    if topo is not None and topo.get("epoch") is not None:
+        completed = min(cfg.NUM_TRAIN_EPOCHS, int(topo["epoch"]))
+        if completed:
+            log(f"auto-resume: restored step {step_num} = epoch "
+                f"{completed} (save-time record, saved at "
+                f"{topo.get('num_processes', '?')} process(es)); "
+                f"training epochs "
+                f"{completed + 1}..{cfg.NUM_TRAIN_EPOCHS}")
+        return completed
+    save_procs = (int(topo["num_processes"])
+                  if topo is not None and topo.get("num_processes")
+                  else jax.process_count())
     spe = steps_per_epoch(count_examples_fn(), cfg.TRAIN_BATCH_SIZE,
-                          jax.process_count())
+                          save_procs)
     completed = min(cfg.NUM_TRAIN_EPOCHS, step_num // spe)
     if completed:
         log(f"auto-resume: restored step {step_num} = {completed} "
-            f"completed epoch(s) x {spe} steps; training epochs "
+            f"completed epoch(s) x {spe} steps (at {save_procs} "
+            f"process(es)); training epochs "
             f"{completed + 1}..{cfg.NUM_TRAIN_EPOCHS}")
     return completed

@@ -175,14 +175,17 @@ class VarMisuseModel:
         cfg = self.config
         # auto-resume epoch offset: the ONE shared arithmetic (see
         # models/setup.resume_epoch_offset — the recovery contract)
-        from code2vec_tpu.models.setup import resume_epoch_offset
+        from code2vec_tpu.models.setup import (infeed_split,
+                                               resume_epoch_offset)
         completed_epochs = resume_epoch_offset(
             cfg, self.step_num, self._n_train_examples, self.log)
+        # per-host infeed split from the LIVE process set (ISSUE 13)
+        host_shard, num_host_shards = infeed_split()
         reader = VMTextReader(
             self._vm_path("train"), self.vocabs, cfg.MAX_CONTEXTS,
             cfg.MAX_CANDIDATES, cfg.TRAIN_BATCH_SIZE, shuffle=True,
-            seed=cfg.SEED, host_shard=jax.process_index(),
-            num_host_shards=jax.process_count(),
+            seed=cfg.SEED, host_shard=host_shard,
+            num_host_shards=num_host_shards,
             epoch_offset=completed_epochs)
         self.log(f"varmisuse training: dims={self.dims}, "
                  f"max_candidates={cfg.MAX_CANDIDATES}")
@@ -298,6 +301,7 @@ class VarMisuseModel:
                 if cfg.is_saving and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
                     # async: kick the save first so eval overlaps the
                     # writer tail (same boundary overlap as jax_model)
+                    self._save_epoch = epoch  # -> step topology record
                     self.save(block=False)
                     epoch_end_work = True
                 if cfg.is_testing and epoch % cfg.SAVE_EVERY_EPOCHS == 0:
@@ -411,6 +415,10 @@ class VarMisuseModel:
                  "trust_ratio": self.config.TRUST_RATIO,
                  "lr_schedule": self.config.LR_SCHEDULE,
                  "lr_warmup_steps": self.config.LR_WARMUP_STEPS}
+        # per-step save-time topology (ISSUE 13): epoch consumed and
+        # reset — see jax_model.save
+        topology = {"epoch": getattr(self, "_save_epoch", None)}
+        self._save_epoch = None
         trace_span = None
         if self.tracer.enabled:
             rec = getattr(self, "_trace_recorder", None)
@@ -431,6 +439,7 @@ class VarMisuseModel:
                     path, state, self.step_num, self.vocabs, self.dims,
                     extra_manifest=extra,
                     max_to_keep=self.config.MAX_TO_KEEP,
+                    topology=topology,
                     telemetry=self.telemetry,
                     tracer=self.tracer if trace_span is not None
                     else None,
@@ -446,7 +455,8 @@ class VarMisuseModel:
                 ckpt.save_checkpoint(path, state, self.step_num,
                                      self.vocabs, self.dims,
                                      extra_manifest=extra,
-                                     max_to_keep=self.config.MAX_TO_KEEP)
+                                     max_to_keep=self.config.MAX_TO_KEEP,
+                                     topology=topology)
                 blocked_ms = blocked_span.stop()
                 self.telemetry.record_ms("train/save_total_ms",
                                          blocked_ms)

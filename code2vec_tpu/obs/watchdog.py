@@ -144,6 +144,7 @@ class Watchdog:
         # attached via attach(): their state tables join the stall dump
         self._health = None
         self._alerts = None
+        self._cohort = None
 
     # ---- construction ----
     @classmethod
@@ -165,14 +166,21 @@ class Watchdog:
             self._components[name] = hb
         return hb
 
-    def attach(self, health=None, alerts=None) -> "Watchdog":
+    def attach(self, health=None, alerts=None,
+               cohort=None) -> "Watchdog":
         """Attach the health-monitor / alert engines (ISSUE 7) so a
         stall dump carries their state tables: one bundle answers both
-        "what is stuck" and "what was already unhealthy"."""
+        "what is stuck" and "what was already unhealthy". `cohort`
+        (ISSUE 13) is a zero-arg callable returning the live cohort
+        topology (the supervisor's `cohort_topology()` — live process
+        set + target size), so a wedged-cohort dump also answers "who
+        was in the mesh"."""
         if health is not None:
             self._health = health
         if alerts is not None:
             self._alerts = alerts
+        if cohort is not None:
+            self._cohort = cohort
         return self
 
     def status(self) -> Dict[str, Dict[str, Any]]:
@@ -321,6 +329,13 @@ class Watchdog:
                        if self._alerts is not None
                        and self._alerts.enabled else []),
         }
+        if self._cohort is not None:
+            # cohort topology (ISSUE 13): best-effort — a dump must
+            # never die on a provider racing a relaunch
+            try:
+                bundle["cohort"] = self._cohort()
+            except Exception as e:
+                bundle["cohort"] = {"error": str(e)}
         if run_dir is None:
             return None
         path = os.path.join(run_dir, f"stall_dump_{seq}.json")
@@ -344,11 +359,12 @@ class _NullWatchdog(Watchdog):
         self._sticky = None
         self._health = None
         self._alerts = None
+        self._cohort = None
 
     def register(self, name, deadline_s=None):
         return _NULL_HEARTBEAT
 
-    def attach(self, health=None, alerts=None):
+    def attach(self, health=None, alerts=None, cohort=None):
         return self
 
     def status(self):

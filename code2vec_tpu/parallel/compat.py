@@ -138,6 +138,23 @@ def distributed_initialize(coordinator_address=None, num_processes=None,
     jax.distributed.initialize(**kwargs)
 
 
+def cohort_world() -> "tuple[int, int]":
+    """(process_index, process_count) of the LIVE cohort this process
+    joined — the one seam topology-dependent host code re-derives the
+    world from (ISSUE 13). After the supervisor re-forms a cohort at
+    N−1, the relaunched children initialize the distributed runtime at
+    the new size and everything built on this seam — the mesh
+    (`models/setup.build_mesh` via `jax.devices()`) and the per-host
+    infeed split (`models/setup.infeed_split`) — rebuilds itself from
+    the surviving process set with no resize-specific code anywhere
+    downstream. Single-process (a cohort re-formed at one survivor, or
+    a plain run) reads (0, 1) without ever touching the distributed
+    runtime."""
+    import jax
+
+    return int(jax.process_index()), int(jax.process_count())
+
+
 def free_port() -> int:
     """An OS-assigned free TCP port for a coordinator about to bind —
     the one definition shared by every multi-process spawner (the

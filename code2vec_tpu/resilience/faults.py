@@ -21,6 +21,11 @@ Sites wired through the codebase:
   train/kill       both train loops — SIGKILL this process mid-epoch
   serve/extract    serving/extractor.Extractor.extract_paths — worker
                    crash the pool must survive
+  serve/kill       serving/server.PredictionServer.predict_lines —
+                   replica-process death on the request path (action
+                   `kill`: the SIGKILL a replica pool must absorb;
+                   ROADMAP item 1's serving-chaos hook, symmetric
+                   with serve/extract)
   dist/init        parallel/distributed.maybe_initialize — transient
                    Gloo/coordination-service connect failure
 

@@ -47,6 +47,7 @@ from code2vec_tpu.common import MethodPredictionResults
 from code2vec_tpu.config import Config
 from code2vec_tpu.obs import (Telemetry, Tracer, Watchdog,
                               build_live_plane)
+from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving.batcher import (MicroBatcher, PredictRequest,
                                           ServerOverloaded)
 from code2vec_tpu.serving.extractor import ExtractorPool
@@ -271,6 +272,12 @@ class PredictionServer:
         out a cold jit compile); None takes `--serve_deadline_ms`."""
         if not self._started:
             self.start()
+        # chaos failpoint (--faults, ISSUE 13): a replica-process death
+        # on the request path (action `kill` — the SIGKILL a replica
+        # pool must absorb; ROADMAP item 1's serving-chaos hook).
+        # Before any span opens so nothing leaks when it fires;
+        # disarmed — the default — it is one None check.
+        faults.fire("serve/kill")
         # host-only filter BEFORE the spans open: nothing here belongs
         # in request_ms, and the acquire-to-try window stays raise-free
         lines = [ln for ln in lines if ln.strip()]

@@ -48,6 +48,15 @@ exactly the storage-rot failure mode quarantine exists for. A committed
 step WITHOUT a checksums file (pre-integrity checkpoints, or a death in
 the rename->checksums window) restores as before, unverified.
 
+Elastic resume (ISSUE 13): each committed step also carries a
+`topology.json` save-time record ({num_processes, epoch}) written by
+process 0 after the commit, so an auto-resume onto a DIFFERENT cohort
+size — the supervisor re-forming a mesh at N−1 after peer loss —
+converts the restored step into completed epochs under the topology
+that counted them (models/setup.resume_epoch_offset), and
+`load_checkpoint` reshards the restored tree onto the new mesh via the
+caller's template while re-verifying the same per-file checksums.
+
 Transient checkpoint-IO errors retry through the shared
 `resilience/retry` policy (single-process only — a multi-host orbax
 save is a collective, and one process re-issuing it alone would
@@ -80,6 +89,7 @@ from code2vec_tpu.vocab.vocabularies import Code2VecVocabs
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 CHECKSUMS_NAME = "checksums.json"
+TOPOLOGY_NAME = "topology.json"
 QUARANTINE_DIRNAME = "quarantine"
 
 
@@ -190,7 +200,8 @@ def _ckpt_io_retry() -> retry_mod.RetryPolicy:
 def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
                     vocabs: Code2VecVocabs, dims: ModelDims,
                     extra_manifest: Optional[Dict[str, Any]] = None,
-                    max_to_keep: int = 10) -> str:
+                    max_to_keep: int = 10,
+                    topology: Optional[Dict[str, Any]] = None) -> str:
     os.makedirs(ckpt_dir, exist_ok=True)
     step_dir = os.path.join(ckpt_dir, f"step_{step}")
     path = os.path.join(step_dir, "state")
@@ -212,6 +223,7 @@ def save_checkpoint(ckpt_dir: str, state: Dict[str, Any], step: int,
         _write()
     if jax.process_index() == 0:
         write_step_checksums(ckpt_dir, step)
+        write_step_topology(ckpt_dir, step, topology)
     _write_sidecars(ckpt_dir, vocabs,
                     _build_manifest(step, dims, extra_manifest))
     # Retention: keep the newest `max_to_keep` step dirs (reference
@@ -262,6 +274,50 @@ def write_step_checksums(ckpt_dir: str, step: int) -> str:
         json.dump(payload, f, indent=1)
     os.replace(tmp, dest)
     return dest
+
+
+def write_step_topology(ckpt_dir: str, step: int,
+                        extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write `step_<N>/topology.json`: the SAVE-TIME topology of this
+    committed step (ISSUE 13 — elastic resume). An auto-resume onto a
+    DIFFERENT cohort size must convert the restored step count into
+    completed epochs using the topology the steps were counted under,
+    not the one restoring; this per-step record is what makes that
+    conversion exact across any resize history (the dir-level manifest
+    is write-once and can't track per-step topology). `extra` adds
+    caller fields — the train loops record the completed `epoch`, which
+    makes the conversion a lookup instead of arithmetic. Written by
+    process 0 after the commit rename, like the checksums manifest; a
+    step WITHOUT one (pre-elastic checkpoints, or a death in the
+    rename->sidecar window) resumes via the old steps//spe arithmetic
+    under the current topology."""
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    payload: Dict[str, Any] = {"step": step,
+                               "num_processes": jax.process_count()}
+    if extra:
+        payload.update({k: v for k, v in extra.items()
+                        if v is not None})
+    dest = os.path.join(step_dir, TOPOLOGY_NAME)
+    tmp = dest + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, dest)
+    return dest
+
+
+def load_step_topology(ckpt_dir: str,
+                       step: int) -> Optional[Dict[str, Any]]:
+    """The step's save-time topology record, or None for pre-elastic
+    checkpoints (and unreadable records — resume then falls back to
+    current-topology arithmetic rather than dying on a sidecar)."""
+    path = os.path.join(ckpt_dir, f"step_{step}", TOPOLOGY_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def verify_step(ckpt_dir: str, step: int) -> Optional[bool]:
@@ -402,7 +458,8 @@ class AsyncCheckpointWriter:
                vocabs: Code2VecVocabs, dims: ModelDims, *,
                extra_manifest: Optional[Dict[str, Any]] = None,
                max_to_keep: int = 10, telemetry=None,
-               tracer=None, trace_ctx=None) -> None:
+               tracer=None, trace_ctx=None,
+               topology: Optional[Dict[str, Any]] = None) -> None:
         """Snapshot `state` and queue the save. Blocks only on the
         snapshot dispatch — unless a previous save is still in flight,
         in which case it blocks until that one commits. `trace_ctx`
@@ -423,6 +480,7 @@ class AsyncCheckpointWriter:
                 "extra_manifest": extra_manifest,
                 "max_to_keep": max_to_keep, "telemetry": telemetry,
                 "tracer": tracer, "trace_ctx": trace_ctx,
+                "topology": topology,
             }
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -449,7 +507,8 @@ class AsyncCheckpointWriter:
                 save_fn(job["ckpt_dir"], job["state"], job["step"],
                         job["vocabs"], job["dims"],
                         extra_manifest=job["extra_manifest"],
-                        max_to_keep=job["max_to_keep"])
+                        max_to_keep=job["max_to_keep"],
+                        topology=job["topology"])
                 total_ms = (self._clock() - t0) * 1e3
                 if tracer is not None:
                     # writer-side span, parented (cross-thread) to the
@@ -561,7 +620,19 @@ def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
     move would race the cohort, so those raise and let the supervisor
     quarantine before relaunch) and the restore falls back to the
     previous committed step. Steps without a checksums manifest restore
-    unverified, as before."""
+    unverified, as before.
+
+    Resharding (ISSUE 13 — the elastic-resume restore path): the
+    restore honors the TEMPLATE's shardings, not the saver's, so a
+    checkpoint written by an N-process cohort redistributes its
+    row-sharded tables and optimizer slots across whatever mesh the
+    surviving cohort rebuilt — orbax reads each process's needed byte
+    ranges from the per-leaf blobs directly. Integrity survives the
+    move because the checksums are per-FILE over the committed state
+    tree (deliberately not per-shard — see the module docstring): the
+    same `verify_step` sweep above re-verifies every file regardless
+    of which topology wrote it or which will read it. A cross-topology
+    restore is logged via the step's save-time `topology.json`."""
     explicit = step is not None
     while True:
         if step is None:
@@ -579,6 +650,13 @@ def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
                    "supervisor, not unilaterally)"))
         quarantine_step(ckpt_dir, step, log)
         step = None  # fall back to the previous committed step
+    saved = load_step_topology(ckpt_dir, step)
+    if (log is not None and saved
+            and saved.get("num_processes") is not None
+            and int(saved["num_processes"]) != jax.process_count()):
+        log(f"checkpoint step {step}: saved by "
+            f"{saved['num_processes']} process(es), restoring onto "
+            f"{jax.process_count()} — resharding onto the new mesh")
     path = os.path.join(ckpt_dir, f"step_{step}", "state")
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                       template)

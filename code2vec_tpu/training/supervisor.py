@@ -1,5 +1,5 @@
-"""Crash-recovery supervisor (ISSUE 10 tentpole, layer 3): close the
-detect -> decide -> recover loop.
+"""Crash-recovery supervisor (ISSUE 10 tentpole, layer 3; elastic
+resize ISSUE 13): close the detect -> decide -> recover loop.
 
 The stack could already DETECT trouble (PR 6 stall watchdog, PR 7 alert
 engine) and SURVIVE it on disk (PR 5 commit-or-vanish checkpoints) —
@@ -18,19 +18,37 @@ makes restart the ordinary path:
     coordination-service heartbeat tolerance evicts the dead peer's
     partners), then are SIGKILLed, and the cohort relaunches
     COHERENTLY — never a half-old half-new mix of processes;
+  - `resize_policy="shrink"` (ISSUE 13) makes peer loss a RESIZE, not
+    a do-over: the next coherent launch re-forms the cohort at N−1
+    processes (floor `min_procs`) instead of relaunching the world at
+    full size, and grows back toward the configured target when a
+    replacement is available (`replacement_fn`). The relaunched
+    children rebuild the mesh and the per-host infeed split from the
+    surviving process set, and the checkpoint layer reshards the
+    restored state onto the new topology (its per-file sha256
+    manifests are resharding-proof by design). Hangs (attempt
+    timeouts) still relaunch at the same size — every member wedging
+    is not evidence one of them is bad. `resize_policy="relaunch"`
+    (the default) keeps the PR-10 full-size behavior;
   - a child that simply finishes (all exit 0) ends the supervised run;
   - the restart budget is bounded, the pacing is the shared
     `resilience/retry` backoff math, and every decision escalates
     through the EXISTING alert engine (`supervisor/*` gauges drive
     edge-triggered `alert` events: restarted -> ticket, quarantined
-    checkpoint -> ticket, budget exhausted -> page).
+    checkpoint -> ticket, cohort resized -> ticket, budget
+    exhausted -> page).
 
 Frequent checkpointing (Check-N-Run) only pays off when restart is
 automatic and verified; this is the piece that makes it so. The spawn
 function is injectable, so the policy logic tests without real
 training runs; `tools/train_supervisor.py` is the CLI entry and
 `tools/chaos.py` drives the acceptance scenarios (SIGKILL parity,
-corrupt-checkpoint fallback) end to end.
+corrupt-checkpoint fallback, kill-and-resize elastic parity) end to
+end. `cohort_topology()` exposes the live process set + target size;
+pass a `watchdog=` (tools/train_supervisor.py does behind
+`--watchdog_stall_s`) and the supervisor attaches it to stall dumps
+and heartbeats its supervise loop — a wedged cohort's postmortem shows
+WHO was in the mesh.
 """
 
 from __future__ import annotations
@@ -64,6 +82,12 @@ def supervisor_alert_rules():
         AlertRule("checkpoint_quarantined",
                   metric="resilience/ckpt_quarantined", op=">=",
                   value=1, severity="ticket"),
+        # elastic re-form (ISSUE 13): a resized cohort keeps training,
+        # but a human should know capacity degraded — warn-tier ticket,
+        # not a page
+        AlertRule("cohort_resized",
+                  metric="supervisor/cohort_resized", op=">=",
+                  value=1, severity="ticket"),
         # an explicit 0/1 gauge, not `restarts_remaining <= 0`: a
         # max_restarts=0 supervisor would otherwise page on a run that
         # SUCCEEDED without ever restarting
@@ -76,26 +100,37 @@ def supervisor_alert_rules():
 class Supervisor:
     """Restart supervisor over an injectable spawn function.
 
-    `spawn_fn(attempt, proc_id, port) -> subprocess.Popen` launches one
-    cohort member (`port` is a fresh coordinator port per attempt, 0
-    for single-process runs). The supervisor owns reaping: no child
-    outlives a failed attempt (the tests/conftest.py leak-guard
-    discipline).
+    `spawn_fn(attempt, proc_id, port, cohort_size) -> subprocess.Popen`
+    launches one cohort member (`port` is a fresh coordinator port per
+    attempt, 0 for single-process launches; `cohort_size` is the size
+    of THIS attempt's cohort — under `resize_policy="shrink"` it can
+    differ from the configured `num_procs`). The supervisor owns
+    reaping: no child outlives a failed attempt (the tests/conftest.py
+    leak-guard discipline).
     """
 
-    def __init__(self, spawn_fn: Callable[[int, int, int],
+    def __init__(self, spawn_fn: Callable[[int, int, int, int],
                                           "subprocess.Popen"], *,
                  num_procs: int = 1, max_restarts: int = 3,
+                 resize_policy: str = "relaunch",
+                 min_procs: int = 1,
+                 replacement_fn: Optional[Callable[[], bool]] = None,
                  ckpt_dir: Optional[str] = None,
-                 telemetry=None,
+                 telemetry=None, watchdog=None,
                  log: Optional[Callable[[str], None]] = None,
                  poll_s: float = 0.2, peer_grace_s: float = 15.0,
                  attempt_timeout_s: Optional[float] = None,
                  backoff: Optional[retry_mod.RetryPolicy] = None,
                  sleep: Callable[[float], None] = time.sleep):
         assert num_procs >= 1 and max_restarts >= 0
+        assert resize_policy in ("relaunch", "shrink"), resize_policy
+        assert 1 <= min_procs <= num_procs, (min_procs, num_procs)
         self._spawn_fn = spawn_fn
-        self.num_procs = num_procs
+        self.num_procs = num_procs      # configured TARGET cohort size
+        self.cur_procs = num_procs      # this attempt's cohort size
+        self.resize_policy = resize_policy
+        self.min_procs = min_procs
+        self.replacement_fn = replacement_fn
         self.max_restarts = max_restarts
         self.ckpt_dir = ckpt_dir
         self._log = log or (lambda m: print(m, flush=True))
@@ -121,6 +156,41 @@ class Supervisor:
         self.restarts = 0
         self.quarantined: List[str] = []
         self.resumed_from_step: Optional[int] = None
+        # elastic bookkeeping (ISSUE 13): every resize decision and the
+        # count of same-size do-overs — the chaos kill_resize scenario
+        # asserts full_relaunches == 0 when shrink handled a peer death
+        self.resizes: List[Tuple[int, int]] = []
+        self.full_relaunches = 0
+        self.last_launch_ts: Optional[float] = None
+        self._procs: List["subprocess.Popen"] = []
+        # watchdog (ISSUE 13 satellite): attach the live cohort
+        # topology to stall dumps and heartbeat the supervise loop —
+        # a supervisor wedged in a hung spawn_fn or a reap that never
+        # ends shows up as a stall whose dump says WHO was in the
+        # mesh. tools/train_supervisor.py wires this behind
+        # --watchdog_stall_s; embedders can also call
+        # Watchdog.attach(cohort=sup.cohort_topology) themselves.
+        self._watchdog_hb = None
+        if watchdog is not None and getattr(watchdog, "enabled", False):
+            watchdog.attach(cohort=self.cohort_topology)
+            self._watchdog_hb = watchdog.register("supervisor_loop")
+
+    def cohort_topology(self) -> dict:
+        """The live cohort, as a stall-dump-attachable snapshot:
+        target vs current size, live member pids, the resize history.
+        Read from other threads (the watchdog's dump path) — every
+        field is rebuilt per call, nothing is mutated."""
+        procs = list(self._procs)
+        return {
+            "target_procs": self.num_procs,
+            "cohort_size": self.cur_procs,
+            "min_procs": self.min_procs,
+            "resize_policy": self.resize_policy,
+            "attempt": self.restarts,
+            "live_pids": [p.pid for p in procs if p.poll() is None],
+            "resizes": [list(r) for r in self.resizes],
+            "full_relaunches": self.full_relaunches,
+        }
 
     # ---- checkpoint verification (runs before EVERY launch) ----
     def verify_checkpoint(self) -> Optional[int]:
@@ -154,65 +224,119 @@ class Supervisor:
                          ) -> None:
         """A peer died: give the rest `peer_grace_s` to notice (the
         coordination-service heartbeat eviction takes them down on
-        their own), then SIGKILL the stragglers — the cohort always
-        relaunches whole."""
+        their own), then SIGKILL the stragglers — the next launch is
+        always a COHERENT cohort, whatever size it re-forms at."""
         deadline = time.monotonic() + self.peer_grace_s
         while time.monotonic() < deadline \
                 and any(p.poll() is None for p in procs):
+            if self._watchdog_hb is not None:
+                self._watchdog_hb.beat()  # the grace wait IS progress
             self._sleep(self.poll_s)
         self._kill_all(procs)
 
-    def _run_cohort(self, attempt: int) -> Tuple[bool, List[int]]:
+    def _run_cohort(self, attempt: int
+                    ) -> Tuple[bool, List[int], str]:
+        """One coherent attempt at the CURRENT cohort size. Returns
+        (ok, exit codes, reason) with reason one of "done",
+        "peer_death", "cohort_failure", "timeout" — the resize policy
+        shrinks only on peer death. A whole-cohort hang (timeout) or
+        EVERY member of a multi-process cohort exiting nonzero
+        together (cohort_failure — the same bad --data path killing
+        all of them identically) is no evidence any ONE member is bad:
+        shrinking would relaunch ever-smaller equally-doomed cohorts,
+        so those relaunch at full size."""
         from code2vec_tpu.parallel.compat import free_port
-        port = free_port() if self.num_procs > 1 else 0
-        procs = [self._spawn_fn(attempt, i, port)
-                 for i in range(self.num_procs)]
+        n = self.cur_procs
+        port = free_port() if n > 1 else 0
+        self.last_launch_ts = time.time()
+        procs = [self._spawn_fn(attempt, i, port, n) for i in range(n)]
+        self._procs = procs
         deadline = (time.monotonic() + self.attempt_timeout_s
                     if self.attempt_timeout_s else None)
         try:
             while True:
                 rcs = [p.poll() for p in procs]
                 if all(rc is not None for rc in rcs):
-                    return all(rc == 0 for rc in rcs), rcs
+                    ok = all(rc == 0 for rc in rcs)
+                    if ok:
+                        return ok, rcs, "done"
+                    # every member of a >1 cohort failed in the same
+                    # poll window: systemic, not a lost peer (a single
+                    # supervised process dying IS its peer dying)
+                    systemic = len(rcs) > 1 \
+                        and all(rc != 0 for rc in rcs)
+                    return ok, rcs, ("cohort_failure" if systemic
+                                     else "peer_death")
                 if any(rc is not None and rc != 0 for rc in rcs):
                     # dead peer detected: coherent cohort teardown
                     self._reap_with_grace(procs)
-                    return False, [p.poll() for p in procs]
+                    return False, [p.poll() for p in procs], \
+                        "peer_death"
                 if deadline is not None and time.monotonic() > deadline:
                     self._log(f"supervisor: attempt {attempt} exceeded "
                               f"{self.attempt_timeout_s:.0f}s — "
                               "killing cohort")
                     self._kill_all(procs)
-                    return False, [p.poll() for p in procs]
+                    return False, [p.poll() for p in procs], "timeout"
+                if self._watchdog_hb is not None:
+                    self._watchdog_hb.beat()  # the loop is alive
                 self._sleep(self.poll_s)
         finally:
             self._kill_all(procs)  # no orphan survives any exit path
+
+    def _next_cohort_size(self, reason: str) -> int:
+        """The resize decision: shrink by one on peer death (floor
+        `min_procs`), then grow back toward the configured target for
+        as many replacements as are available — a replacement arriving
+        in the same window the peer died re-fills its slot, so the
+        cohort re-forms at N, not N−1."""
+        size = self.cur_procs
+        if self.resize_policy == "shrink" and reason == "peer_death":
+            size = max(self.min_procs, size - 1)
+        while (self.replacement_fn is not None
+               and size < self.num_procs and self.replacement_fn()):
+            size += 1
+        return size
 
     # ---- the supervised run ----
     def run(self) -> int:
         self.telemetry.gauge("supervisor/restarts", 0, emit=False)
         self.telemetry.gauge("supervisor/restarts_remaining",
                              self.max_restarts, emit=False)
+        self.telemetry.gauge("supervisor/cohort_target",
+                             self.num_procs, emit=False)
         while True:
+            if self._watchdog_hb is not None:
+                # covers the pre-launch checkpoint-verify sweep; size
+                # --watchdog_stall_s above that sweep (the train
+                # loops' eval-vs-deadline guidance applies here too)
+                self._watchdog_hb.beat()
             step = self.verify_checkpoint()
             if self.restarts > 0 or step is not None:
                 self.resumed_from_step = step
+            self.telemetry.gauge("supervisor/cohort_size",
+                                 self.cur_procs, emit=False)
             self.telemetry.event(
                 "supervisor_launch", attempt=self.restarts,
-                num_procs=self.num_procs,
+                num_procs=self.cur_procs,
+                cohort_target=self.num_procs,
                 resume_step=step if step is not None else -1)
             if step is not None:
                 self._log(f"supervisor: launching attempt "
-                          f"{self.restarts} (resume from verified "
+                          f"{self.restarts} at {self.cur_procs} "
+                          f"process(es) (resume from verified "
                           f"step {step})")
-            ok, rcs = self._run_cohort(self.restarts)
+            ok, rcs, reason = self._run_cohort(self.restarts)
             self.telemetry.event("supervisor_attempt",
                                  attempt=self.restarts, ok=ok,
-                                 exit_codes=rcs)
+                                 num_procs=self.cur_procs,
+                                 reason=reason, exit_codes=rcs)
             if ok:
                 self._log(f"supervisor: run completed after "
                           f"{self.restarts} restart(s)")
                 self.alerts.check_now()
+                if self._watchdog_hb is not None:
+                    self._watchdog_hb.idle()  # no deadline after done
                 return 0
             self.restarts += 1
             self.telemetry.count("supervisor/attempts_failed")
@@ -221,6 +345,28 @@ class Supervisor:
             self.telemetry.gauge("supervisor/restarts_remaining",
                                  self.max_restarts - self.restarts,
                                  emit=False)
+            # elastic re-form (ISSUE 13): decide the NEXT cohort size
+            # before the budget check so the resize escalates in the
+            # same alert sweep as the restart itself
+            new_size = self._next_cohort_size(reason)
+            if new_size != self.cur_procs:
+                self.resizes.append((self.cur_procs, new_size))
+                self.telemetry.count("resilience/resize")
+                self.telemetry.gauge("supervisor/cohort_resized",
+                                     len(self.resizes), emit=False)
+                self.telemetry.gauge("supervisor/cohort_size",
+                                     new_size, emit=False)
+                self.telemetry.event("cohort_resized",
+                                     from_procs=self.cur_procs,
+                                     to_procs=new_size, reason=reason)
+                self._log(f"supervisor: re-forming cohort at "
+                          f"{new_size} process(es) (was "
+                          f"{self.cur_procs}; {reason})")
+                self.cur_procs = new_size
+            else:
+                self.full_relaunches += 1
+                self.telemetry.gauge("supervisor/full_relaunches",
+                                     self.full_relaunches, emit=False)
             self.alerts.check_now()
             if self.restarts > self.max_restarts:
                 self.telemetry.gauge("supervisor/budget_exhausted", 1,
@@ -236,6 +382,11 @@ class Supervisor:
             self._log(f"supervisor: cohort died (exit codes {rcs}); "
                       f"relaunching in {delay:.2f}s "
                       f"(restart {self.restarts}/{self.max_restarts})")
+            if self._watchdog_hb is not None:
+                # the backoff sleep is a DELIBERATE wait (up to the
+                # policy's max delay), not silence: exempt it from the
+                # deadline; the loop-top beat re-arms on relaunch
+                self._watchdog_hb.idle()
             self._sleep(delay)
 
 
@@ -243,22 +394,28 @@ def build_cli_spawn(child_cmd: Sequence[str], *, num_procs: int = 1,
                     out_dir: Optional[str] = None,
                     cpu_devices: Optional[int] = None,
                     log: Optional[Callable[[str], None]] = None
-                    ) -> Callable[[int, int, int], "subprocess.Popen"]:
+                    ) -> Callable[[int, int, int, int],
+                                  "subprocess.Popen"]:
     """Spawn function over a CLI child command (tools/train_supervisor
     and tools/chaos use this). Multi-process cohorts get the explicit
     `--dist_*` coordination flags appended per member (fresh port per
-    attempt); `cpu_devices` pins the CPU harness's virtual device count
-    via `parallel/compat.cpu_worker_env`, BEFORE the child's jax
-    import. Child output streams to `attempt<k>.proc<i>.log` under
-    `out_dir` (or inherits the supervisor's stdio)."""
+    attempt, sized to THIS attempt's cohort — a re-formed N−1 cohort
+    gets N−1 in its flags, so the children rebuild mesh + infeed split
+    from the surviving process set; a cohort re-formed at ONE process
+    gets no flags at all and runs plain single-process);
+    `cpu_devices` pins the CPU harness's virtual device count via
+    `parallel/compat.cpu_worker_env`, BEFORE the child's jax import.
+    Child output streams to `attempt<k>.proc<i>.log` under `out_dir`
+    (or inherits the supervisor's stdio)."""
     child_cmd = list(child_cmd)
 
-    def spawn(attempt: int, proc_id: int, port: int
-              ) -> "subprocess.Popen":
+    def spawn(attempt: int, proc_id: int, port: int,
+              cohort_size: Optional[int] = None) -> "subprocess.Popen":
+        n = num_procs if cohort_size is None else cohort_size
         cmd = list(child_cmd)
-        if num_procs > 1:
+        if n > 1:
             cmd += ["--dist_coordinator", f"127.0.0.1:{port}",
-                    "--dist_num_processes", str(num_procs),
+                    "--dist_num_processes", str(n),
                     "--dist_process_id", str(proc_id)]
         if cpu_devices is not None:
             from code2vec_tpu.parallel.compat import cpu_worker_env

@@ -186,8 +186,77 @@ def test_multichip_cli_kind_selects_pattern_and_metrics():
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert r.returncode == 1, r.stdout + r.stderr
     rows = json.loads(r.stdout)
-    assert {row["metric"] for row in rows} == {"scaling_efficiency",
-                                              "multi_pc_per_sec"}
+    assert {row["metric"] for row in rows} == {
+        "scaling_efficiency", "multi_pc_per_sec",
+        "recovery_steps_lost", "recovery_seconds"}
+
+
+def test_multichip_recovery_metrics_gate_lower_is_better():
+    """ISSUE 13 satellite: the kill-mid-run recovery costs gate with
+    the band flipped into a CEILING — ok/ fixtures keep the latest
+    inside it, regress/ blows recovery_seconds past it while the
+    steps-lost series stays flat."""
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "ok"),
+                   ["recovery_steps_lost", "recovery_seconds"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 0
+    assert [r["status"] for r in rows] == ["ok", "ok"]
+    assert all(r["lower_is_better"] for r in rows)
+
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "regress"),
+                   ["recovery_steps_lost", "recovery_seconds"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 1
+    by = {r["metric"]: r for r in rows}
+    assert by["recovery_seconds"]["status"] == "REGRESSION"
+    assert by["recovery_steps_lost"]["status"] == "ok"
+
+
+def test_lower_is_better_direction_flips_the_band():
+    """check_metric's direction logic: a DROP in a lower-is-better
+    metric is never a regression (it's the improvement), a rise past
+    the banded ceiling is; the same values under a higher-is-better
+    metric read the opposite way."""
+    hist = [(1, 30.0), (2, 31.0)]
+    worse = check_metric("recovery_seconds", hist, 3, 80.0,
+                         band_floor=0.05, min_history=2)
+    assert worse["status"] == "REGRESSION" and worse["lower_is_better"]
+    assert worse["floor"] > worse["baseline"]  # a ceiling, not a floor
+    better = check_metric("recovery_seconds", hist, 3, 5.0,
+                          band_floor=0.05, min_history=2)
+    assert better["status"] == "ok"
+    # same numbers, throughput-style metric: the 5.0 IS the regression
+    throughput = check_metric("multi_pc_per_sec", hist, 3, 5.0,
+                              band_floor=0.05, min_history=2)
+    assert throughput["status"] == "REGRESSION"
+    assert not throughput["lower_is_better"]
+
+
+def test_lower_is_better_zero_baseline_still_gates():
+    """A perfect-recovery history (baseline 0) must keep gating a
+    cost metric — 0 is the BEST possible baseline there, not broken
+    data (the throughput-metric skip rule stays)."""
+    hist = [(1, 0.0), (2, 0.0)]
+    worse = check_metric("recovery_steps_lost", hist, 3, 50.0,
+                         band_floor=0.05, min_history=2)
+    assert worse["status"] == "REGRESSION"
+    assert worse["ratio"] is None  # undefined over a 0 baseline
+    assert "—" in render([worse])  # and renders without crashing
+    perfect = check_metric("recovery_steps_lost", hist, 3, 0.0,
+                           band_floor=0.05, min_history=2)
+    assert perfect["status"] == "ok"
+    # a zero-baseline THROUGHPUT series is still broken data -> skip
+    thr = check_metric("multi_pc_per_sec", hist, 3, 50.0,
+                       band_floor=0.05, min_history=2)
+    assert thr["status"] == "skip"
+
+
+def test_multichip_default_metrics_include_recovery_gate():
+    from tools.bench_regression import MULTICHIP_METRICS
+    assert "recovery_steps_lost" in MULTICHIP_METRICS
+    assert "recovery_seconds" in MULTICHIP_METRICS
 
 
 def test_multichip_repo_trajectory_accepted():

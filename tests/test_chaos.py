@@ -47,6 +47,28 @@ def test_chaos_corrupt_checkpoint_quarantine_and_alert(tmp_path):
 
 
 @pytest.mark.slow
+def test_chaos_kill_resize_elastic_parity(tmp_path):
+    """ISSUE 13 acceptance: SIGKILL one peer of a 2-process cohort
+    mid-epoch; the supervisor re-forms the mesh at 1 process (a
+    RESIZE — zero full-cohort relaunches), the checkpoint layer
+    reshards the restore, and the final params are bit-identical to an
+    uninterrupted 1-process run resumed from the same committed step
+    (constant LR). The policy/reshard/resume contracts stay
+    tier-1-covered at unit level in tests/test_resilience.py and
+    tests/test_elastic.py."""
+    result = _run("kill_resize", tmp_path)
+    assert result["kill_fired"]
+    assert result["restarts"] == 1
+    assert result["resizes"] == [[2, 1]]
+    assert result["full_relaunches"] == 0
+    assert result["param_diffs"] == []
+    assert result["oracle_step"] == result["chaos_step"]
+    assert result["recovery_steps_lost"] >= 0
+    assert result["recovery_seconds"] is None \
+        or result["recovery_seconds"] > 0
+
+
+@pytest.mark.slow
 def test_chaos_kill_resume_2proc_parity(tmp_path):
     """The same parity contract through the 2-process Gloo cohort:
     worker 1 SIGKILLed mid-epoch, dead peer detected, cohort reaped

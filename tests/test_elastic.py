@@ -1,0 +1,307 @@
+"""Elastic cohort recovery (ISSUE 13) — the tier-1 unit halves of the
+slow kill_resize chaos scenario: checkpoint reshard round-trips across
+topology change (row-sharded tables, optimizer state, int8 tables,
+corrupt-file quarantine mid-reshard), the per-step save-time topology
+record and the topology-independent resume arithmetic, and the
+reader's global-permutation data order (same global stream under any
+host count)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models.encoder import ModelDims
+from code2vec_tpu.parallel.mesh import make_mesh
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.training import checkpoint as ckpt
+from code2vec_tpu.vocab.vocabularies import Code2VecVocabs, Vocab, \
+    VocabType
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tiny_vocabs():
+    return Code2VecVocabs(Vocab(VocabType.Token, ["a", "b"]),
+                          Vocab(VocabType.Path, ["1"]),
+                          Vocab(VocabType.Target, ["t"]))
+
+
+def _tiny_dims():
+    return ModelDims(token_vocab_size=8, path_vocab_size=8,
+                     target_vocab_size=8, embeddings_size=4,
+                     max_contexts=4, dropout_keep_rate=1.0)
+
+
+def _opt_like(table: np.ndarray) -> dict:
+    """Adam-slot-shaped optimizer state over one table."""
+    return {"mu": np.asarray(table) * 0.25,
+            "nu": np.asarray(table) ** 2}
+
+
+def _host_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree)
+
+
+def _assert_trees_bit_equal(a, b):
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(la) == len(lb)
+    for (ka, va), (kb, vb) in zip(la, lb):
+        assert ka == kb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb),
+                                      err_msg=str(ka))
+
+
+def _shard_rows(tree, mesh):
+    """Row-shard every rank-2 leaf over the mesh's model axis (the
+    vocab-table layout); everything else replicates."""
+    def put(x):
+        if not hasattr(x, "ndim"):
+            return x
+        spec = P("model", None) if getattr(x, "ndim", 0) == 2 else P()
+        return jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, tree)
+
+
+# ------------------------------------- reshard round-trips (tentpole)
+
+def test_reshard_roundtrip_row_sharded_tables_and_opt_state(tmp_path):
+    """Save at a 2-shard model axis, restore onto no mesh (N=1) and
+    back onto a DIFFERENT (4-shard) mesh: params AND optimizer slots
+    bit-equal to the unresharded tree in both directions — the
+    checkpoint layer's cross-topology restore promise, exercised
+    explicitly (the kill_resize chaos scenario rides exactly this)."""
+    d = str(tmp_path)
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal((8, 4)).astype(np.float32)
+    state = {"params": {"token_emb": table},
+             "opt_state": _opt_like(table), "step": 7}
+
+    mesh2 = make_mesh(0, 2)
+    sharded = dict(state)
+    sharded["params"] = _shard_rows(state["params"], mesh2)
+    sharded["opt_state"] = _shard_rows(state["opt_state"], mesh2)
+    ckpt.save_checkpoint(d, sharded, 7, _tiny_vocabs(), _tiny_dims())
+
+    # N=2 (row-sharded) -> N=1 (plain single-device template)
+    flat = ckpt.load_checkpoint(d, state)
+    _assert_trees_bit_equal(_host_tree(flat), state)
+
+    # ... and back up onto a WIDER mesh (4-way row shards)
+    mesh4 = make_mesh(0, 4)
+    template = dict(state)
+    template["params"] = _shard_rows(state["params"], mesh4)
+    template["opt_state"] = _shard_rows(state["opt_state"], mesh4)
+    wide = ckpt.load_checkpoint(d, template)
+    shard_shapes = {
+        s.data.shape
+        for s in wide["params"]["token_emb"].addressable_shards}
+    assert shard_shapes == {(2, 4)}  # genuinely redistributed
+    _assert_trees_bit_equal(_host_tree(wide), state)
+
+
+def test_reshard_roundtrip_int8_tables(tmp_path):
+    """The quantized {q int8, s f32} table subtrees survive the same
+    cross-mesh round-trip bit-exactly — requantization never happens
+    on the restore path."""
+    from code2vec_tpu.ops.quant import quantize_table
+    d = str(tmp_path)
+    rng = np.random.default_rng(1)
+    qt = jax.tree_util.tree_map(
+        np.asarray,
+        quantize_table(jnp.asarray(
+            rng.standard_normal((8, 4)).astype(np.float32))))
+    state = {"params": {"token_emb": qt}, "step": 3}
+
+    mesh = make_mesh(0, 2)
+    sharded = {"params": {"token_emb": _shard_rows(qt, mesh)},
+               "step": 3}
+    ckpt.save_checkpoint(d, sharded, 3, _tiny_vocabs(), _tiny_dims())
+
+    flat = ckpt.load_checkpoint(d, state)
+    _assert_trees_bit_equal(_host_tree(flat), state)
+    assert _host_tree(flat)["params"]["token_emb"]["q"].dtype \
+        == np.int8
+
+    mesh4 = make_mesh(0, 4)
+    template = {"params": {"token_emb": _shard_rows(qt, mesh4)},
+                "step": 3}
+    wide = ckpt.load_checkpoint(d, template)
+    _assert_trees_bit_equal(_host_tree(wide), state)
+
+
+def test_corrupt_file_during_reshard_quarantines_and_falls_back(
+        tmp_path):
+    """PR-10 quarantine semantics hold ON the reshard path: a
+    bit-flipped blob in the latest step is caught by the per-file
+    checksums (they are resharding-proof by design), the step is
+    quarantined, and the CROSS-TOPOLOGY restore falls back to the
+    prior committed step."""
+    d = str(tmp_path)
+    rng = np.random.default_rng(2)
+    t1 = rng.standard_normal((8, 4)).astype(np.float32)
+    t2 = t1 + 1.0
+    mesh = make_mesh(0, 2)
+    for step, t in ((1, t1), (2, t2)):
+        ckpt.save_checkpoint(
+            d, {"params": {"w": _shard_rows({"w": t}, mesh)["w"]},
+                "step": step}, step, _tiny_vocabs(), _tiny_dims())
+    # flip a byte in step_2's largest state blob
+    blobs = []
+    for base, _dirs, files in os.walk(os.path.join(d, "step_2",
+                                                   "state")):
+        blobs += [os.path.join(base, f) for f in files]
+    target = max(blobs, key=os.path.getsize)
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    # restore onto the NEW topology (no mesh): quarantine + fallback
+    restored = ckpt.load_checkpoint(
+        d, {"params": {"w": t1}, "step": 0})
+    assert int(np.asarray(restored["step"])) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), t1)
+    assert os.path.isdir(os.path.join(d, "quarantine", "step_2"))
+
+
+# -------------------------- save-time topology record + resume math
+
+def test_step_topology_record_written_and_loaded(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, {"params": {"w": np.zeros((2, 2),
+                                                      np.float32)},
+                             "step": 4},
+                         4, _tiny_vocabs(), _tiny_dims(),
+                         topology={"epoch": 2})
+    topo = ckpt.load_step_topology(d, 4)
+    assert topo == {"step": 4, "num_processes": 1, "epoch": 2}
+    # None-valued extras are dropped, num_processes always recorded
+    ckpt.write_step_topology(d, 4, {"epoch": None})
+    assert ckpt.load_step_topology(d, 4) == {"step": 4,
+                                             "num_processes": 1}
+    # pre-elastic step: no record, no crash
+    assert ckpt.load_step_topology(d, 99) is None
+
+
+def _resume_cfg(tmp_path, **kw):
+    cfg = Config(TRAIN_BATCH_SIZE=32, NUM_TRAIN_EPOCHS=6,
+                 AUTO_RESUME=True)
+    cfg.train_data_path = "unused"
+    cfg.load_path = str(tmp_path)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_resume_epoch_offset_prefers_save_time_epoch(tmp_path):
+    """The restored step's save-time record wins: a step count
+    accumulated under a 2-process topology resumed by a 1-process
+    cohort must NOT be divided by the 1-process steps-per-epoch (the
+    arithmetic that would be wrong is never run)."""
+    from code2vec_tpu.models.setup import resume_epoch_offset
+    os.makedirs(tmp_path / "step_4")
+    (tmp_path / "step_4" / ckpt.TOPOLOGY_NAME).write_text(json.dumps(
+        {"step": 4, "num_processes": 2, "epoch": 2}))
+    cfg = _resume_cfg(tmp_path)
+    logs = []
+    # 96 examples, B=32: spe(1 proc)=3 would give 4//3=1 — WRONG; the
+    # record says the 2-proc run (spe=2) had finished epoch 2
+    completed = resume_epoch_offset(cfg, 4, lambda: 96, logs.append)
+    assert completed == 2
+    assert any("save-time record" in m for m in logs)
+
+
+def test_resume_epoch_offset_uses_saved_process_count(tmp_path):
+    """No epoch field (a record written by a non-boundary save): the
+    division runs under the SAVE-TIME process count, not the current
+    one."""
+    from code2vec_tpu.models.setup import resume_epoch_offset
+    os.makedirs(tmp_path / "step_4")
+    (tmp_path / "step_4" / ckpt.TOPOLOGY_NAME).write_text(json.dumps(
+        {"step": 4, "num_processes": 2}))
+    cfg = _resume_cfg(tmp_path)
+    # spe at the SAVED 2-proc topology = ceil(ceil(96/2)/32) = 2
+    assert resume_epoch_offset(cfg, 4, lambda: 96,
+                               lambda _m: None) == 2
+
+
+def test_resume_epoch_offset_pre_elastic_falls_back(tmp_path):
+    """Pre-elastic checkpoint (no topology.json): PR-10 arithmetic
+    under the current topology — exact for a never-resized history."""
+    from code2vec_tpu.models.setup import resume_epoch_offset
+    os.makedirs(tmp_path / "step_6")
+    cfg = _resume_cfg(tmp_path)
+    assert resume_epoch_offset(cfg, 6, lambda: 96,
+                               lambda _m: None) == 2  # 6 // spe(1)=3
+
+
+# -------------------------------- topology-independent data order
+
+def test_global_data_order_is_topology_independent(tmp_path):
+    """The per-epoch shuffle is ONE global permutation sliced per
+    host: with equal GLOBAL batch, the union of examples each global
+    step consumes is identical for a 1-host and a 2-host topology —
+    the elastic parity bar's data-order half."""
+    from code2vec_tpu.data.reader import open_reader
+    from tests.helpers import build_tiny_dataset, load_tiny_vocabs
+    prefix = build_tiny_dataset(str(tmp_path), n_train=48, n_val=8,
+                                n_test=8, max_contexts=8)
+    vocabs = load_tiny_vocabs(prefix)
+
+    def step_multisets(num_hosts, per_host_batch):
+        per_host = []
+        for h in range(num_hosts):
+            r = open_reader(prefix + ".train.c2v", vocabs, 8,
+                            per_host_batch, shuffle=True, seed=5,
+                            host_shard=h, num_host_shards=num_hosts)
+            per_host.append([b.target_index[:b.num_valid_examples]
+                             for b in r])
+        steps = []
+        for parts in zip(*per_host):
+            steps.append(sorted(np.concatenate(parts).tolist()))
+        return steps
+
+    one = step_multisets(1, 16)   # global batch 16
+    two = step_multisets(2, 8)    # global batch 2 x 8 = 16
+    assert len(one) == 3
+    assert one == two
+
+
+def test_epoch_offset_replay_is_topology_independent(tmp_path):
+    """A reader resumed at epoch k under a NEW host count replays the
+    exact global stream an uninterrupted reader at that host count
+    would produce for epoch k — shuffle state is (seed, epoch) alone,
+    never topology history."""
+    from code2vec_tpu.data.reader import open_reader
+    from tests.helpers import build_tiny_dataset, load_tiny_vocabs
+    prefix = build_tiny_dataset(str(tmp_path), n_train=48, n_val=8,
+                                n_test=8, max_contexts=8)
+    vocabs = load_tiny_vocabs(prefix)
+
+    def epoch_batches(reader):
+        return [b.target_index.copy() for b in reader]
+
+    cold = open_reader(prefix + ".train.c2v", vocabs, 8, 16,
+                       shuffle=True, seed=7)
+    _first, second = epoch_batches(cold), epoch_batches(cold)
+    resumed = open_reader(prefix + ".train.c2v", vocabs, 8, 16,
+                          shuffle=True, seed=7, epoch_offset=1)
+    for a, b in zip(second, epoch_batches(resumed)):
+        np.testing.assert_array_equal(a, b)

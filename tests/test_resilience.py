@@ -439,10 +439,14 @@ def test_per_input_failure_does_not_restart_pool(tmp_path):
 
 # ------------------------------------------------------- supervisor
 
-def _sh_spawn(script_for_attempt, out_dir):
+def _sh_spawn(script_for_attempt, out_dir, record=None):
     """Spawn fn over trivial python children; script_for_attempt maps
-    the attempt number to per-process python source."""
-    def spawn(attempt, proc_id, port):
+    the attempt number to per-process python source. `record` (a list)
+    captures every (attempt, proc_id, cohort_size) spawn — the resize
+    tests assert the re-formed cohort's actual shape from it."""
+    def spawn(attempt, proc_id, port, cohort_size=None):
+        if record is not None:
+            record.append((attempt, proc_id, cohort_size))
         return subprocess.Popen(
             [sys.executable, "-c",
              script_for_attempt(attempt, proc_id, port)])
@@ -502,6 +506,172 @@ def test_supervisor_dead_peer_reaps_and_relaunches_cohort():
     assert sup.run() == 0
     assert sup.restarts == 1
     assert time.monotonic() - t0 < 20  # never waited out the sleeper
+
+
+def test_supervisor_shrink_reforms_cohort_at_n_minus_1():
+    """ISSUE 13 tentpole (unit half of tools/chaos.py kill_resize):
+    a dead peer under resize_policy='shrink' RE-FORMS the cohort at
+    N−1 — the next attempt spawns one process, not two — recorded as a
+    resize (never a full relaunch), counted, and escalated through the
+    alert engine as the warn-tier `cohort_resized` ticket."""
+    def script(attempt, proc_id, port):
+        if attempt == 0 and proc_id == 1:
+            return "import sys; sys.exit(9)"
+        if attempt == 0:
+            return "import time; time.sleep(30)"
+        return "import sys; sys.exit(0)"
+
+    spawns = []
+    sup = _supervisor(_sh_spawn(script, None, record=spawns),
+                      num_procs=2, max_restarts=2,
+                      resize_policy="shrink")
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert sup.resizes == [(2, 1)]
+    assert sup.full_relaunches == 0
+    assert sup.cur_procs == 1
+    # attempt 0 spawned a 2-cohort; attempt 1 re-formed at exactly one
+    assert [(a, p, n) for a, p, n in spawns if a == 0] == \
+        [(0, 0, 2), (0, 1, 2)]
+    assert [(a, p, n) for a, p, n in spawns if a == 1] == [(1, 0, 1)]
+    assert sup.telemetry.counters["resilience/resize"] == 1
+    assert sup.telemetry.gauges["supervisor/cohort_size"] == 1
+    assert sup.telemetry.gauges["supervisor/cohort_target"] == 2
+    table = {r["rule"]: r for r in sup.alerts.status_table()}
+    assert table["cohort_resized"]["state"] == "firing"
+    assert table["cohort_resized"]["severity"] == "ticket"
+
+
+def test_supervisor_shrink_floors_at_min_procs():
+    """min_procs is the shrink floor: a cohort already at the floor
+    relaunches at the same size after a peer death (a full relaunch,
+    counted as such)."""
+    def script(attempt, proc_id, port):
+        if attempt == 0 and proc_id == 1:
+            return "import sys; sys.exit(1)"
+        if attempt == 0:
+            return "import time; time.sleep(30)"
+        return "import sys; sys.exit(0)"
+
+    spawns = []
+    sup = _supervisor(_sh_spawn(script, None, record=spawns),
+                      num_procs=2, max_restarts=2,
+                      resize_policy="shrink", min_procs=2)
+    assert sup.run() == 0
+    assert sup.resizes == []
+    assert sup.full_relaunches == 1
+    assert all(n == 2 for _a, _p, n in spawns)
+
+
+def test_supervisor_grows_back_when_replacement_available():
+    """Grow-back: once a replacement is configured and available, the
+    next re-form returns toward the configured target size N."""
+    replacements = [False, True]  # none at first death, one later
+
+    def script(attempt, proc_id, port):
+        if attempt == 0:  # one peer of the 2-cohort dies
+            return ("import sys; sys.exit(1)" if proc_id == 1
+                    else "import time; time.sleep(30)")
+        if attempt == 1:  # the shrunk 1-cohort's only member dies
+            return "import sys; sys.exit(1)"
+        return "import sys; sys.exit(0)"
+
+    spawns = []
+    sup = _supervisor(
+        _sh_spawn(script, None, record=spawns), num_procs=2,
+        max_restarts=3, resize_policy="shrink",
+        replacement_fn=lambda: replacements.pop(0)
+        if replacements else False)
+    assert sup.run() == 0
+    # death at 2 -> shrink to 1 (no replacement); death at 1 -> floor
+    # holds, replacement arrives -> grow back to 2; 2-cohort finishes
+    assert sup.resizes == [(2, 1), (1, 2)]
+    assert [n for _a, _p, n in spawns] == [2, 2, 1, 2, 2]
+
+
+def test_supervisor_systemic_failure_keeps_full_size():
+    """EVERY member of a multi-process cohort exiting nonzero together
+    is systemic (the same bad flag killing all of them identically),
+    not peer loss: shrink policy keeps the size — relaunching
+    ever-smaller equally-doomed cohorts helps nobody."""
+    spawns = []
+
+    def spawn(attempt, proc_id, port, cohort_size=None):
+        spawns.append((attempt, proc_id, cohort_size))
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; sys.exit(2)" if attempt == 0
+             else "import sys; sys.exit(0)"])
+        p.wait()  # already exited at the supervisor's FIRST poll —
+        #           both deaths land in one sweep, deterministically
+        return p
+
+    sup = _supervisor(spawn, num_procs=2, max_restarts=2,
+                      resize_policy="shrink")
+    assert sup.run() == 0
+    assert sup.resizes == []
+    assert sup.full_relaunches == 1
+    assert all(n == 2 for _a, _p, n in spawns)
+
+
+def test_supervisor_timeout_relaunches_at_full_size():
+    """A whole-cohort hang (attempt timeout) is NOT peer death: shrink
+    policy keeps the size — every member wedging is no evidence any
+    one of them is bad."""
+    def script(attempt, proc_id, port):
+        return ("import time; time.sleep(30)" if attempt == 0
+                else "import sys; sys.exit(0)")
+
+    spawns = []
+    sup = _supervisor(_sh_spawn(script, None, record=spawns),
+                      num_procs=2, max_restarts=2,
+                      resize_policy="shrink", attempt_timeout_s=0.5)
+    assert sup.run() == 0
+    assert sup.resizes == []
+    assert sup.full_relaunches == 1
+    assert all(n == 2 for _a, _p, n in spawns)
+
+
+def test_cohort_topology_joins_watchdog_stall_dump(tmp_path):
+    """ISSUE 13 satellite: the supervisor's live cohort topology
+    (process set + target size) rides the watchdog's stall dump via
+    `attach(cohort=...)` — the wedged-cohort postmortem answers 'who
+    was in the mesh'."""
+    import json as json_mod
+
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.obs.watchdog import Watchdog
+    clk = {"t": 0.0}
+    tele = Telemetry.create(str(tmp_path / "tele"), component="sup")
+    wd = Watchdog(tele, stall_s=1.0, clock=lambda: clk["t"])
+    # the Supervisor(watchdog=) wiring (what tools/train_supervisor.py
+    # does behind --watchdog_stall_s): attaches cohort_topology and
+    # registers the supervise-loop heartbeat
+    sup = _supervisor(
+        _sh_spawn(lambda a, p, port: "import sys; sys.exit(0)", None),
+        num_procs=2, resize_policy="shrink", watchdog=wd)
+    assert wd._cohort is not None
+    assert "supervisor_loop" in wd.status()
+    topo = sup.cohort_topology()
+    assert topo["target_procs"] == 2 and topo["cohort_size"] == 2
+    assert topo["resize_policy"] == "shrink"
+    # a completed run leaves the supervise-loop heartbeat idle — the
+    # deadline must not apply to a supervisor with nothing to watch
+    assert sup.run() == 0
+    assert wd.status()["supervisor_loop"]["active"] is False
+
+    hb = wd.register("cohort")
+    hb.busy()
+    clk["t"] = 5.0
+    stalls = wd.check_now()
+    tele.close()
+    assert len(stalls) == 1
+    dumps = list((tmp_path / "tele").glob("*/stall_dump_*.json"))
+    assert dumps, "stall dump missing"
+    bundle = json_mod.loads(dumps[0].read_text())
+    assert bundle["cohort"]["target_procs"] == 2
+    assert bundle["cohort"]["cohort_size"] == 2
+    assert "live_pids" in bundle["cohort"]
 
 
 def test_supervisor_verifies_and_quarantines_before_launch(tmp_path):

@@ -221,6 +221,33 @@ def test_server_matches_direct_predict(served_model):
         server.close()
 
 
+def test_serve_kill_failpoint_on_request_path(served_model):
+    """ISSUE 13 satellite (ROADMAP item 1's hook): the `serve/kill`
+    failpoint sits on the replica request path, symmetric with
+    serve/extract — armed, it fires before any span opens; disarmed
+    (the default), it is one None check. The real scenario arms it
+    with action `kill` (replica SIGKILL); here `raise` proves the
+    seam without killing the test process."""
+    from code2vec_tpu.resilience import FaultInjected, faults
+    cfg, model = served_model
+    server = PredictionServer(cfg, model)
+    server.start()
+    try:
+        faults.install({"seed": 0, "sites": {
+            "serve/kill": {"action": "raise", "at": 1}}},
+            log=lambda _m: None)
+        with pytest.raises(FaultInjected):
+            server.predict_lines(make_raw_lines(1, seed=3))
+        assert faults.stats()["serve/kill"]["fired"] == 1
+        faults.clear()
+        # the seam leaked nothing: the next request serves normally
+        assert len(server.predict_lines(make_raw_lines(1,
+                                                       seed=3))) == 1
+    finally:
+        faults.clear()
+        server.close()
+
+
 def test_server_cache_hits_skip_device(served_model):
     cfg, model = served_model
     server = PredictionServer(cfg, model)

@@ -221,8 +221,7 @@ def test_injected_writer_hang_fires_watchdog(tele, tmp_path):
     hang = threading.Event()
     entered = threading.Event()
 
-    def stuck_save(ckpt_dir, state, step, vocabs, dims,
-                   extra_manifest=None, max_to_keep=10):
+    def stuck_save(ckpt_dir, state, step, vocabs, dims, **kw):
         entered.set()
         hang.wait(10)
 

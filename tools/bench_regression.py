@@ -9,9 +9,10 @@ the check mechanical:
   python tools/bench_regression.py            # repo root, defaults
   python tools/bench_regression.py --dir . --band 0.05
 
-For each gated metric (higher-is-better throughput figures), the
-LATEST round is compared against the MEDIAN of the previous
-`--window` rounds that report the metric. The tolerance band is the
+For each gated metric (higher-is-better throughput figures, plus a
+LOWER_IS_BETTER set — the elastic-recovery costs — where the band
+flips into a ceiling), the LATEST round is compared against the
+MEDIAN of the previous `--window` rounds that report the metric. The tolerance band is the
 larger of `--band` (the noise floor — slope timing on the tunneled
 platform jitters a few percent run-to-run) and the observed relative
 spread of those prior rounds (median absolute deviation × 2 / median),
@@ -54,8 +55,19 @@ DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
 # scaling efficiency is the headline — a pod that got faster per chip
 # but lost more to the process boundary is a regression this gate must
 # see; multi_pc_per_sec catches absolute multi-leg slowdowns the ratio
-# could mask (both legs regressing together).
-MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec")
+# could mask (both legs regressing together). The kill-mid-run leg
+# (ISSUE 13) adds the recovery-cost pair — gated LOWER-is-better: a
+# re-form that loses more steps or takes longer to reach its first
+# post-resize step is the regression.
+MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
+                     "recovery_steps_lost", "recovery_seconds")
+
+# Metrics where SMALLER is healthier: the band becomes a ceiling
+# (baseline * (1 + band)) instead of a floor. Everything else in the
+# gate — median baseline, MAD-widened band, history windowing — is
+# direction-agnostic.
+LOWER_IS_BETTER = frozenset({"recovery_steps_lost",
+                             "recovery_seconds"})
 
 KINDS = {
     "bench": ("BENCH_r*.json", DEFAULT_METRICS),
@@ -126,7 +138,9 @@ def check_metric(metric: str, history: List[Tuple[int, float]],
                  latest_round: int, latest: float,
                  band_floor: float, min_history: int
                  ) -> Dict[str, Any]:
-    """One metric's verdict row. `history` excludes the latest round."""
+    """One metric's verdict row. `history` excludes the latest round.
+    LOWER_IS_BETTER metrics regress when the latest rises ABOVE the
+    banded ceiling; everything else when it falls below the floor."""
     row: Dict[str, Any] = {"metric": metric, "round": latest_round,
                            "latest": latest}
     if len(history) < min_history:
@@ -135,15 +149,28 @@ def check_metric(metric: str, history: List[Tuple[int, float]],
         return row
     values = [v for _r, v in history]
     baseline = _median(values)
-    if baseline <= 0:
+    lower_better = metric in LOWER_IS_BETTER
+    # a non-positive baseline means broken data for a throughput
+    # metric — but for a lower-is-better COST metric, 0 is the best
+    # possible baseline (perfect recovery) and any positive latest is
+    # exactly the regression the gate exists for
+    if (baseline <= 0 and not lower_better) \
+            or (lower_better and baseline < 0):
         row.update(status="skip", note="non-positive baseline")
         return row
     mad = _median([abs(v - baseline) for v in values])
-    band = max(band_floor, 2.0 * mad / baseline)
-    floor = baseline * (1.0 - band)
-    row.update(baseline=baseline, band=band, floor=floor,
-               ratio=latest / baseline,
-               status="REGRESSION" if latest < floor else "ok",
+    band = band_floor if baseline == 0 \
+        else max(band_floor, 2.0 * mad / baseline)
+    if lower_better:
+        bound = baseline * (1.0 + band)
+        regressed = latest > bound
+    else:
+        bound = baseline * (1.0 - band)
+        regressed = latest < bound
+    row.update(baseline=baseline, band=band, floor=bound,
+               lower_is_better=lower_better,
+               ratio=latest / baseline if baseline > 0 else None,
+               status="REGRESSION" if regressed else "ok",
                history_rounds=[r for r, _v in history])
     return row
 
@@ -183,8 +210,8 @@ def run(dir_path: str, metrics: List[str], band: float, window: int,
 
 
 def render(rows: List[Dict[str, Any]]) -> str:
-    lines = ["| Metric | latest | baseline (median) | floor (band) "
-             "| ratio | verdict |",
+    lines = ["| Metric | latest | baseline (median) | floor/ceiling "
+             "(band) | ratio | verdict |",
              "|---|---|---|---|---|---|"]
 
     def f(v, nd=1):
@@ -195,11 +222,13 @@ def render(rows: List[Dict[str, Any]]) -> str:
             lines.append(f"| {r['metric']} | {f(r.get('latest'))} "
                          f"| — | — | — | skip: {r['note']} |")
             continue
+        ratio = ("—" if r.get("ratio") is None
+                 else f"{r['ratio']:.3f}")
         lines.append(
             f"| {r['metric']} | {f(r['latest'])} "
             f"| {f(r['baseline'])} "
             f"| {f(r['floor'])} ({r['band'] * 100:.1f}%) "
-            f"| {r['ratio']:.3f} | {r['status']} |")
+            f"| {ratio} | {r['status']} |")
     return "\n".join(lines)
 
 

@@ -24,6 +24,19 @@ failpoint, and asserts the recovery contract:
                      detects it, QUARANTINES the step dir, emits an
                      `alert` event through the alert engine, and the
                      run resumes from the prior committed step.
+  kill_resize        The elastic-resume parity bar (ISSUE 13): SIGKILL
+                     one peer of a 2-process cohort mid-epoch; the
+                     supervisor (resize_policy=shrink) RE-FORMS the
+                     cohort at 1 process instead of relaunching the
+                     world — zero full-cohort relaunches — the
+                     checkpoint layer reshards the restore onto the
+                     new mesh, and the finished run's params are
+                     BIT-IDENTICAL to an uninterrupted 1-process run
+                     resumed from the same committed step (constant
+                     LR). Also measures recovery cost
+                     (recovery_steps_lost, recovery_seconds — the
+                     multichip bench's kill-mid-run leg reuses the
+                     run half of this scenario).
 
 Usage (repo root):
 
@@ -181,7 +194,7 @@ def _write_faults(path: str, sites: dict) -> str:
 def _supervised(child_cmd: list, *, out: str, num_procs: int = 1,
                 cpu_devices: int = 1, max_restarts: int = 2,
                 ckpt_dir: str, telemetry_dir: str | None = None,
-                attempt_timeout_s: float = 600.0):
+                attempt_timeout_s: float = 600.0, **sup_kwargs):
     from code2vec_tpu.obs import Telemetry
     from code2vec_tpu.resilience.retry import RetryPolicy
     from code2vec_tpu.training.supervisor import (Supervisor,
@@ -201,7 +214,7 @@ def _supervised(child_cmd: list, *, out: str, num_procs: int = 1,
         peer_grace_s=10.0, attempt_timeout_s=attempt_timeout_s,
         backoff=RetryPolicy("supervisor-restart", max_attempts=1,
                             base_delay_s=0.2, max_delay_s=1.0,
-                            seed=0))
+                            seed=0), **sup_kwargs)
     try:
         rc = sup.run()
     finally:
@@ -324,6 +337,182 @@ def scenario_kill_resume_2proc(out: str, *, epochs: int = 3,
     return result
 
 
+def _step_event_times(tele_root: str) -> list:
+    """(ts, step) for every per-step telemetry event under any run dir
+    of `tele_root`. JSONL is flushed per event, so even a SIGKILLed
+    attempt's steps are on disk up to the kill."""
+    import glob as glob_mod
+    out = []
+    for path in glob_mod.glob(os.path.join(tele_root, "*",
+                                           "events.jsonl")):
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                ev = json.loads(ln)
+                if ev.get("kind") == "step":
+                    out.append((float(ev["ts"]), int(ev["step"])))
+    return sorted(out)
+
+
+def _marker_ts(marker: str) -> float | None:
+    """The firing wall-clock the fault site wrote into its once-latch
+    marker (`... ts=<float>`)."""
+    import re as re_mod
+    try:
+        with open(marker, encoding="utf-8") as f:
+            m = re_mod.search(r"ts=([0-9.]+)", f.read())
+        return float(m.group(1)) if m else None
+    except OSError:
+        return None
+
+
+def run_kill_resize(out: str, *, epochs: int = 3, kill_at_step: int = 4,
+                    procs: int = 2, cpu_devices: int = 2,
+                    timeout_s: float = 600.0, tries: int = 3) -> dict:
+    """The run half of the kill_resize scenario, reused by
+    tools/multichip_bench.py's kill-mid-run leg: train a `procs`-process
+    cohort under the shrink-policy supervisor, SIGKILL worker 1 at
+    `kill_at_step`, let the cohort RE-FORM at procs−1, and measure the
+    recovery cost — steps lost (kill step minus the committed step the
+    re-formed cohort resumed from) and seconds from the kill to the
+    first post-resize training step (per-step telemetry events from the
+    relaunched children, against the kill timestamp the fault marker
+    recorded).
+
+    The CPU harness's loopback-Gloo transport race (the compat
+    docstring's `op.preamble.length <= op.nbytes` crash) can abort a
+    cohort at startup BEFORE the injected kill arms — the supervisor
+    handles it per its policy (a lone early death resizes, a
+    simultaneous whole-cohort crash relaunches full size as
+    `cohort_failure`), but as a measurement such a try is transient
+    infra, not the contract: it is retried in a fresh subdir (the
+    multichip_bench pair-retry discipline) until the kill actually
+    fired after a committed checkpoint existed."""
+    last = None
+    for i in range(max(1, tries)):
+        sub = os.path.join(out, f"try{i}")
+        os.makedirs(sub, exist_ok=True)
+        last = _run_kill_resize_once(
+            sub, epochs=epochs, kill_at_step=kill_at_step,
+            procs=procs, cpu_devices=cpu_devices, timeout_s=timeout_s)
+        if (last["kill_fired"] and last["supervisor_rc"] == 0
+                and last["resumed_from_step"] is not None):
+            return last
+        print(f"[chaos] kill_resize try {i} hit transient infra "
+              f"(kill_fired={last['kill_fired']}, resumed="
+              f"{last['resumed_from_step']}); retrying in a fresh dir",
+              flush=True)
+    return last
+
+
+def _run_kill_resize_once(out: str, *, epochs: int, kill_at_step: int,
+                          procs: int, cpu_devices: int,
+                          timeout_s: float) -> dict:
+    prefix = build_dataset(os.path.join(out, "data"))
+    chaos_dir = os.path.join(out, "ckpt_chaos")
+    child_tele = os.path.join(out, "child_tele")
+    marker = os.path.join(out, "killed.once")
+    faults = _write_faults(os.path.join(out, "faults.json"), {
+        "train/kill": {"action": "kill", "at": kill_at_step,
+                       "process": 1, "marker": marker}})
+    # sync checkpointing: the contract under test is TOPOLOGY recovery
+    # from a committed step, so the committed step must be
+    # deterministic — on this harness post-compile steps run ~20 ms
+    # while the 2-process collective async commit takes hundreds, so a
+    # mid-epoch kill would race (and essentially always beat) the
+    # boundary save. The mid-ASYNC-save kill discipline for fixed
+    # cohorts is kill_resume's job (shipped defaults there).
+    cmd = train_cmd(prefix, chaos_dir, epochs=epochs) \
+        + ["--async_checkpoint", "off",
+           "--auto_resume", "--faults", faults,
+           "--telemetry_dir", child_tele]
+    rc, sup, run_dir = _supervised(
+        cmd, out=out, num_procs=procs, cpu_devices=cpu_devices,
+        ckpt_dir=chaos_dir, telemetry_dir=os.path.join(out, "tele"),
+        attempt_timeout_s=timeout_s,
+        resize_policy="shrink", min_procs=1)
+
+    kill_ts = _marker_ts(marker)
+    resumed = sup.resumed_from_step
+    steps = _step_event_times(child_tele)
+    first_post = next((ts for ts, _s in steps
+                       if sup.last_launch_ts is not None
+                       and ts >= sup.last_launch_ts), None)
+    recovery_seconds = (round(first_post - kill_ts, 3)
+                        if first_post is not None
+                        and kill_ts is not None else None)
+    recovery_steps_lost = (kill_at_step - resumed
+                           if resumed is not None else kill_at_step)
+    return {
+        "kill_fired": os.path.exists(marker),
+        "supervisor_rc": rc,
+        "restarts": sup.restarts,
+        "resizes": [list(r) for r in sup.resizes],
+        "full_relaunches": sup.full_relaunches,
+        "cohort_size_final": sup.cur_procs,
+        "resumed_from_step": resumed,
+        "kill_at_step": kill_at_step,
+        "recovery_steps_lost": recovery_steps_lost,
+        "recovery_seconds": recovery_seconds,
+        "data_prefix": prefix,
+        "ckpt_dir": chaos_dir,
+        "telemetry_run_dir": run_dir,
+    }
+
+
+def scenario_kill_resize(out: str, *, epochs: int = 3,
+                         kill_at_step: int = 4) -> dict:
+    """SIGKILL one peer of a 2-process cohort mid-epoch; the supervisor
+    re-forms the mesh at 1 process (a resize, ZERO full-cohort
+    relaunches), the checkpoint reshards onto the survivor, and the
+    final params are bit-identical to an uninterrupted 1-process run
+    resumed from the same committed step (constant LR) — the elastic
+    resume parity bar (ISSUE 13)."""
+    import shutil
+    t0 = time.time()
+    run = run_kill_resize(out, epochs=epochs,
+                          kill_at_step=kill_at_step)
+    result = dict(run, scenario="kill_resize",
+                  wall_s=None, param_diffs=["<not compared>"])
+    chaos_dir = run["ckpt_dir"]
+    S = run["resumed_from_step"]
+    if run["supervisor_rc"] != 0 or S is None:
+        result["ok"] = False
+        result["wall_s"] = round(time.time() - t0, 1)
+        return result
+
+    # the oracle: an UNINTERRUPTED 1-process run resumed from the SAME
+    # committed step the re-formed cohort restored — committed step
+    # dirs are immutable, so the chaos dir still holds the exact bytes
+    oracle_dir = os.path.join(out, "ckpt_oracle")
+    os.makedirs(oracle_dir)
+    shutil.copytree(os.path.join(chaos_dir, f"step_{S}"),
+                    os.path.join(oracle_dir, f"step_{S}"))
+    for sidecar in ("manifest.json", "vocab.pkl"):
+        shutil.copy(os.path.join(chaos_dir, sidecar),
+                    os.path.join(oracle_dir, sidecar))
+    # cpu_devices + checkpoint mode match the re-formed chaos child
+    # (1 process x 2 virtual devices, sync saves) so the two runs
+    # differ in NOTHING but history
+    _run_plain(train_cmd(run["data_prefix"], oracle_dir, epochs=epochs)
+               + ["--async_checkpoint", "off", "--auto_resume"],
+               cpu_devices=2, timeout_s=600)
+
+    o_step, o_state = _latest_state(oracle_dir)
+    c_step, c_state = _latest_state(chaos_dir)
+    diffs = trees_bit_equal(o_state, c_state)
+    result.update(
+        oracle_step=o_step, chaos_step=c_step, param_diffs=diffs,
+        wall_s=round(time.time() - t0, 1))
+    result["ok"] = (run["kill_fired"] and run["supervisor_rc"] == 0
+                    and run["restarts"] == 1
+                    and run["resizes"] == [[2, 1]]
+                    and run["full_relaunches"] == 0
+                    and o_step == c_step and not diffs)
+    return result
+
+
 def _flip_byte_in_largest_blob(step_dir: str) -> str:
     """Flip one byte mid-file in the largest file of the committed
     state tree — the bit-rot the checksums exist to catch."""
@@ -397,6 +586,7 @@ def scenario_corrupt_checkpoint(out: str) -> dict:
 SCENARIOS = {
     "kill_resume": scenario_kill_resume,
     "kill_resume_2proc": scenario_kill_resume_2proc,
+    "kill_resize": scenario_kill_resize,
     "corrupt_checkpoint": scenario_corrupt_checkpoint,
 }
 

@@ -31,6 +31,16 @@ Usage (repo root):
   python tools/multichip_bench.py --telemetry_dir /tmp/tele
       # per-process run dirs + the `telemetry_report.py --merge` table
 
+Kill-mid-run leg (ISSUE 13, on by default; `--no_kill_leg` skips it):
+after the scaling pairs, the driver runs the elastic-recovery half of
+`tools/chaos.py kill_resize` — a real 2-process training cohort under
+the shrink-policy supervisor, one peer SIGKILLed mid-epoch, the cohort
+re-formed at 1 process — and records the recovery cost into the round
+file: `recovery_steps_lost` (kill step minus the committed step the
+re-formed cohort resumed from) and `recovery_seconds` (kill to first
+post-resize training step). `bench_regression --kind multichip` gates
+both as lower-is-better.
+
 Writes `MULTICHIP_r<next>.json` into `--out` (default: repo root; the
 seed rounds r01-r05 are the driver's failed-dryrun records — their
 shape carries no metrics and `tools/bench_regression.py --kind
@@ -416,6 +426,9 @@ def main(argv=None) -> int:
                     help="print JSON only, write no round file")
     ap.add_argument("--timeout_s", type=float, default=900.0,
                     help="per-leg wall clock before workers are killed")
+    ap.add_argument("--no_kill_leg", action="store_true",
+                    help="skip the kill-mid-run recovery leg (the "
+                         "elastic-resume cost measurement)")
     ap.add_argument("--reps", type=int, default=3,
                     help="baseline/multi leg pairs to run back-to-back;"
                          " the MEDIAN-ratio pair is reported (shared "
@@ -490,6 +503,25 @@ def main(argv=None) -> int:
                   f"{multi['ms_per_step_p50']:.0f} ms, ratio "
                   f"{base['ms_per_step_p50'] / multi['ms_per_step_p50']:.3f}",
                   file=sys.stderr)
+
+        # kill-mid-run leg (ISSUE 13): the elastic-recovery cost of a
+        # REAL training cohort losing a peer — reuses the run half of
+        # tools/chaos.py kill_resize (shrink-policy supervisor, fault-
+        # injected SIGKILL, re-form at N−1)
+        kill_leg = None
+        if not args.no_kill_leg:
+            from tools import chaos as chaos_mod
+            print("kill leg: 2-process cohort, SIGKILL one peer, "
+                  "re-form at 1 ...", file=sys.stderr)
+            kill_dir = os.path.join(tmp, "kill_leg")
+            os.makedirs(kill_dir, exist_ok=True)
+            kill_leg = chaos_mod.run_kill_resize(
+                kill_dir, timeout_s=args.timeout_s)
+            print(f"kill leg: resumed from step "
+                  f"{kill_leg['resumed_from_step']}, steps lost "
+                  f"{kill_leg['recovery_steps_lost']}, recovery "
+                  f"{kill_leg['recovery_seconds']}s, resizes "
+                  f"{kill_leg['resizes']}", file=sys.stderr)
         wall = time.time() - t0
 
     # elect the median-ratio pair: each pair's legs ran back-to-back,
@@ -505,6 +537,31 @@ def main(argv=None) -> int:
     result = build_result(base, multi, args)
     result["bench_wall_s"] = wall
     result["rep_retries"] = rep_retries
+    if kill_leg is not None:
+        # the leg is a MEASUREMENT only when the injected kill really
+        # fired after a committed checkpoint existed and the re-formed
+        # cohort finished — a leg that lost every retry to the
+        # loopback-Gloo startup race must not smuggle fabricated
+        # numbers into the gated trajectory (they'd read as a phantom
+        # regression now, then pad the MAD band against real ones)
+        valid = bool(kill_leg["kill_fired"]
+                     and kill_leg["supervisor_rc"] == 0
+                     and kill_leg["resumed_from_step"] is not None)
+        result["kill_leg"] = dict(
+            {k: kill_leg[k] for k in
+             ("kill_fired", "supervisor_rc", "restarts", "resizes",
+              "full_relaunches", "cohort_size_final",
+              "resumed_from_step", "kill_at_step")}, valid=valid)
+        if valid:
+            # gated headline metrics at top level (bench_regression
+            # reads them flat, lower-is-better)
+            result["recovery_steps_lost"] = \
+                kill_leg["recovery_steps_lost"]
+            result["recovery_seconds"] = kill_leg["recovery_seconds"]
+        else:
+            print("kill leg invalid after retries (transient infra); "
+                  "recovery metrics NOT recorded this round",
+                  file=sys.stderr)
     result["reps"] = [{"scaling_efficiency": r,
                        "baseline_ms_per_step_p50": b["ms_per_step_p50"],
                        "multi_ms_per_step_p50": m["ms_per_step_p50"],

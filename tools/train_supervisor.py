@@ -58,6 +58,14 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=1,
                     help="cohort size; >1 appends --dist_* flags per "
                          "member on a fresh port per attempt")
+    ap.add_argument("--resize_policy", choices=("relaunch", "shrink"),
+                    default="relaunch",
+                    help="on peer death: 'relaunch' the whole cohort "
+                         "at full size (PR-10 behavior) or 'shrink' — "
+                         "re-form the mesh at N-1 processes (floor "
+                         "--min_procs) and keep training (ISSUE 13)")
+    ap.add_argument("--min_procs", type=int, default=1,
+                    help="smallest cohort 'shrink' may re-form at")
     ap.add_argument("--cpu_devices", type=int, default=None,
                     help="pin this many virtual CPU devices per child "
                          "(the Gloo CPU harness) via the spawn env")
@@ -72,6 +80,11 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry_dir", default=None,
                     help="supervisor run telemetry (supervisor_* + "
                          "alert JSONL events)")
+    ap.add_argument("--watchdog_stall_s", type=float, default=0.0,
+                    help="with --telemetry_dir: stall watchdog over "
+                         "the supervise loop; a missed deadline dumps "
+                         "diagnostics INCLUDING the live cohort "
+                         "topology (process set + target size)")
     ap.add_argument("--out_dir", default=None,
                     help="per-attempt child logs "
                          "(attempt<k>.proc<i>.log); default: inherit "
@@ -104,13 +117,21 @@ def main(argv=None) -> int:
     telemetry = Telemetry.create(args.telemetry_dir,
                                  component="supervisor", log=log) \
         if args.telemetry_dir else None
+    watchdog = None
+    if args.watchdog_stall_s > 0 and telemetry is not None:
+        from code2vec_tpu.obs import Watchdog
+        watchdog = Watchdog.create(telemetry,
+                                   stall_s=args.watchdog_stall_s,
+                                   log=log).start()
 
     sup = Supervisor(
         build_cli_spawn(child, num_procs=args.procs,
                         out_dir=args.out_dir,
                         cpu_devices=args.cpu_devices, log=log),
         num_procs=args.procs, max_restarts=args.max_restarts,
-        ckpt_dir=save_dir, telemetry=telemetry, log=log,
+        resize_policy=args.resize_policy, min_procs=args.min_procs,
+        ckpt_dir=save_dir, telemetry=telemetry, watchdog=watchdog,
+        log=log,
         peer_grace_s=args.peer_grace_s,
         attempt_timeout_s=args.attempt_timeout_s,
         backoff=RetryPolicy("supervisor-restart", max_attempts=1,
@@ -122,6 +143,8 @@ def main(argv=None) -> int:
         log(str(e))
         rc = 3
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         if telemetry is not None:
             telemetry.close()
     return rc
