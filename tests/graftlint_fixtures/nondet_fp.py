@@ -1,0 +1,86 @@
+"""nondeterminism TRICKY FALSE POSITIVES: deterministic-by-
+construction shapes that must stay quiet — the sanctioned seams and
+the order-insensitive consumers."""
+
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def step_keyed_fold_in(rng, step):
+    # THE sanctioned resume-exact rng idiom (PR 10): a pure function of
+    # (seed, step)
+    return jax.random.fold_in(rng, step)
+
+
+def seeded_streams(seed, n):
+    # seeded generators are parity-safe: np.random.default_rng(seed)
+    # and random.Random(seed) are not the global streams
+    rng = np.random.default_rng(seed)
+    r = random.Random(seed)
+    return jnp.asarray(rng.normal(size=n)), r.random()
+
+
+class JitteredRetry:
+    """The seeded retry jitter (resilience/retry.py shape): an
+    INSTANCE stream, injectable and seedable — not the global one."""
+
+    def __init__(self, seed):
+        self._rng = random.Random(seed)
+
+    def delay_s(self, base):
+        return base * (1.0 - 0.5 * self._rng.random())
+
+
+def sorted_listing(d, load):
+    # sorted() makes the listing order deterministic — the _step_dirs
+    # idiom
+    rows = [load(n) for n in sorted(os.listdir(d))]
+    return np.asarray(rows)
+
+
+def sorted_set_tensor(ids):
+    # sorting a set kills the iteration-order hazard
+    return jnp.asarray(sorted(set(ids)))
+
+
+def set_membership(scores, tried):
+    # membership/aggregation reads are order-insensitive: the
+    # build_shortlist shape (inf-mask by set, then argpartition)
+    for t in tried:
+        scores[t] = np.inf
+    return len(tried), np.argpartition(scores, 3)[:3]
+
+
+def set_comparison(v):
+    # `set(v) >= {...}` is a membership test — the is_quantized shape
+    if set(v) >= {"q", "s"}:
+        return jnp.zeros((2, 2))
+    return None
+
+
+def telemetry_timestamp(telemetry, loss):
+    # timestamps belong in event logs: telemetry is not a parity sink
+    telemetry.event("step", ts=round(time.time(), 6), loss=loss)
+
+
+def throughput_window(examples):
+    # wall clock feeding THROUGHPUT math, not tensors/rng/checkpoints
+    t0 = time.time()
+    dt = time.time() - t0
+    return examples / max(dt, 1e-9)
+
+
+def per_host_tag_rows(local_batch):
+    # process identity into a tensor is the multihost row-tagging
+    # MECHANISM (jax_model._my_global_rows), not nondeterminism
+    return np.full((local_batch,), jax.process_index(), np.int32)
+
+
+def dithered_requantize(x, idx, salt, dither_from_index):
+    # the sanctioned deterministic counter-hash dither (ops/quant.py)
+    return jnp.round(x + dither_from_index(idx, salt))

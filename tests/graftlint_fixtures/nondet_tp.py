@@ -1,0 +1,78 @@
+"""nondeterminism TRUE POSITIVES: nondeterministic values reaching the
+resume-parity surface. Every shape must flag."""
+
+import glob
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def clock_seeded_key():
+    # wall clock -> rng seam: two processes (or a resumed run) draw
+    # different streams
+    seed = int(time.time())
+    return jax.random.PRNGKey(seed)
+
+
+def clock_fold_in(rng):
+    # the ANTI-pattern of the sanctioned step-keyed fold_in
+    return jax.random.fold_in(rng, int(time.time() * 1e3))
+
+
+def global_rng_tensor(n):
+    # the unseeded module-global stream into a tensor
+    noise = [random.random() for _ in range(n)]
+    return jnp.asarray(noise)
+
+
+def set_order_tensor(ids, extra):
+    tried = set(ids) | {extra}
+    # list() materializes the set's ITERATION ORDER into the tensor
+    return jnp.asarray(list(tried))
+
+
+def listing_order_rows(d, load):
+    names = os.listdir(d)  # unsorted: kernel-dependent order
+    rows = [load(n) for n in names]
+    return np.asarray(rows)
+
+
+def glob_into_checkpoint(ckpt_dir, d, save_checkpoint, vocabs, dims):
+    shards = glob.glob(os.path.join(d, "*.c2v"))
+    # shard ORDER rides into checkpointed state -> resume reads a
+    # different order than the run that wrote it
+    save_checkpoint(ckpt_dir, {"shards": shards}, 0, vocabs, dims)
+
+
+def save_checkpoint(ckpt_dir, state, step, vocabs, dims):
+    """Stands in for the real seam (named checkpoint sink)."""
+
+
+def loop_var_into_checkpoint(d, vocabs, dims):
+    # the loop variable inherits the iterable's order-taint
+    for shard in glob.glob(os.path.join(d, "*.c2v")):
+        save_checkpoint("/ckpt", {"shard": shard}, 0, vocabs, dims)
+
+
+def seed_kwarg_from_clock(open_reader, path):
+    return open_reader(path, seed=int(time.monotonic()))
+
+
+def _wall_clock_stamp():
+    # no sink HERE — the hazard is in the caller, one hop away
+    t = time.time()
+    return t
+
+
+def interprocedural_source(rng):
+    # fires only through _wall_clock_stamp's summary (returns_nondet)
+    return jax.random.fold_in(rng, int(_wall_clock_stamp()))
+
+
+def object_identity_seed(obj):
+    # id() differs per process/run even for equal values
+    return jax.random.PRNGKey(id(obj) % (1 << 31))

@@ -1,0 +1,89 @@
+"""spmd-divergence TRICKY FALSE POSITIVES: every shape here is
+process-UNIFORM (or an audited seam) and must stay quiet."""
+
+import json
+import os
+
+import jax
+
+
+def branch_on_process_count(x):
+    # process_count is cohort-uniform: every process agrees, so every
+    # process takes the same arm — the multi-host guard idiom
+    if jax.process_count() > 1:
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def process_zero_sidecar(ckpt_dir, step):
+    # the audited post-commit seam: process 0 diverges to write FILE
+    # sidecars AFTER the collective completed — no collective inside
+    if jax.process_index() == 0:
+        with open(os.path.join(ckpt_dir, "checksums.json"), "w") as f:
+            json.dump({"step": step}, f)
+
+
+def rejoined_branch(x):
+    # both arms rejoin before the collective: every process reaches it
+    if jax.process_index() == 0:
+        log_line = "coordinator"
+    else:
+        log_line = "worker"
+    return jax.lax.psum(x, "data"), log_line
+
+
+def reassigned_rank(x):
+    rank = jax.process_index()
+    rank = 0  # reassignment kills the per-host taint
+    if rank == 0:
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def version_probe(f, mesh, x):
+    # the compat seam: TypeError depends on the installed wheel, which
+    # a homogeneous cohort shares — every process takes the same arm
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=None,
+                             out_specs=None, check_vma=False)(x)
+    except TypeError:
+        return jax.shard_map(f, mesh=mesh, in_specs=None,
+                             out_specs=None, check_rep=False)(x)
+
+
+def sharded_reader_loop(open_reader, step, state):
+    # a call result built FROM per-host identity is opaque: the reader
+    # aligns batch counts across hosts by contract (the audited
+    # pad-to-aligned-batches invariant) — iterating it is uniform
+    reader = open_reader(host_shard=jax.process_index(),
+                         num_host_shards=jax.process_count())
+    for batch in reader:
+        state = step(state, batch)
+        _loss = jax.lax.psum(state, "data")
+    return state
+
+
+def per_host_scalar_writer(writer_cls, path):
+    # per-host VALUES without collectives are fine — only process 0
+    # gets a real tensorboard dir, the rest get None
+    return writer_cls(path if jax.process_index() == 0 else None)
+
+
+def lambda_defined_not_executed(x):
+    # DEFINING a closure holding a collective executes nothing — the
+    # per-branch reducer pattern; calling it (wherever that happens)
+    # is a separate site in its own frame
+    if jax.process_index() == 0:
+        fn = lambda v: jax.lax.psum(v, "data")  # noqa: E731
+    else:
+        fn = lambda v: v  # noqa: E731
+    return fn
+
+
+def uniform_handler_telemetry(step, state, log):
+    try:
+        return step(state)
+    except RuntimeError as e:
+        # divergent handler, but no collective inside: record + re-raise
+        log(f"step failed: {e}")
+        raise

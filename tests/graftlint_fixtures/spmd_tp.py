@@ -1,0 +1,97 @@
+"""spmd-divergence TRUE POSITIVES: collective effects only some
+processes execute. Every shape here must flag (tests/test_graftlint.py
+asserts the exact symbol set)."""
+
+import jax
+
+
+def branch_on_process_index(x):
+    # the textbook deadlock: only process 0 enters the collective
+    if jax.process_index() == 0:
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def branch_on_assigned_rank(x, mesh):
+    rank = jax.process_index()
+    is_zero = rank == 0
+    if is_zero:
+        # taint survives assignment + comparison; shard_map bodies run
+        # collectives, so entering one divergently deadlocks too
+        return jax.shard_map(lambda a: a, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    return x
+
+
+def divergent_early_exit(x):
+    if jax.process_index() != 0:
+        return x
+    # only process 0 ever reaches this line
+    return jax.lax.all_gather(x, "data")
+
+
+def collective_in_exception_handler(step, state):
+    try:
+        return step(state)
+    except RuntimeError:
+        # only the host that raised re-issues the collective save —
+        # its peers are not in the rendezvous (the distributed-
+        # deadlock retry class)
+        return save_checkpoint("/tmp/ckpt", state, 0, None, None)
+
+
+def save_checkpoint(ckpt_dir, state, step, vocabs, dims):
+    """Stands in for training/checkpoint.save_checkpoint (named seam +
+    body effect for the summary layer)."""
+    import orbax.checkpoint as ocp
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_dir, state)
+
+
+def _sync_helper(x):
+    # no divergence HERE — the effect is inherited by divergent callers
+    return jax.lax.psum(x, "data")
+
+
+def interprocedural_reach(x):
+    if jax.process_index() == 0:
+        # the collective is one call away: only the summary layer
+        # (ISSUE 14) can see it
+        return _sync_helper(x)
+    return x
+
+
+def _my_rank():
+    return int(jax.process_index())
+
+
+def divergent_test_via_summary(x):
+    # the TEST is per-host one call away: _my_rank()'s summary says it
+    # returns process identity
+    if _my_rank() == 0:
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def ternary_collective(x, flag):
+    # divergence expressed as an IfExp arm
+    out = jax.lax.pmean(x, "data") if jax.process_index() == 0 else x
+    return out, flag
+
+
+class RankedSaver:
+    def __init__(self, writer):
+        self._ckpt_writer = writer
+
+    def maybe_submit(self, state):
+        if jax.process_index() == 0:
+            # the async writer's submit IS a collective save sequence:
+            # every process must issue it
+            self._ckpt_writer.submit("/tmp/ckpt", state, 1, None, None)
+
+
+def loop_over_local_devices(x):
+    for _d in jax.local_devices():
+        # trip count differs on a heterogeneous pod slice
+        x = jax.lax.psum(x, "data")
+    return x

@@ -1,0 +1,43 @@
+"""Summary-layer RECURSION/CYCLE fixture (ISSUE 14 satellite): the
+fixpoint must TERMINATE on self-recursion and mutual call cycles
+(facts are monotone finite sets), and a clean cycle must stay quiet —
+while an effect inside a cycle still propagates to every member."""
+
+import jax
+
+
+def clean_self_recursive(n):
+    # self-recursion, no effects: summaries converge to empty
+    if n <= 0:
+        return 0
+    return clean_self_recursive(n - 1) + 1
+
+
+def ping(n):
+    # mutual recursion, no effects
+    if n <= 0:
+        return 0
+    return pong(n - 1)
+
+
+def pong(n):
+    if n <= 0:
+        return 1
+    return ping(n - 1)
+
+
+def cyc_a(x, n):
+    # a cycle CONTAINING a collective: both members' summaries carry it
+    if n <= 0:
+        return x
+    return cyc_b(x, n - 1)
+
+
+def cyc_b(x, n):
+    x = jax.lax.psum(x, "data")
+    return cyc_a(x, n)
+
+
+def uniform_cycle_user(x, n):
+    # uniform control calling into the effectful cycle: must stay quiet
+    return cyc_a(x, n)

@@ -1,0 +1,38 @@
+"""Summary-layer TWO-HOP true positives (ISSUE 14 satellite): the
+hazardous effect sits TWO resolved calls below the divergent /
+sinking site, so nothing intraprocedural — and no single-hop
+special-case — can see it. Both rules must fire here purely through
+the propagated summaries."""
+
+import time
+
+import jax
+
+
+def _leaf_collective(x):
+    return jax.lax.psum(x, "data")
+
+
+def _middle(x):
+    # hop 1: no effect of its own, inherits _leaf_collective's
+    return _leaf_collective(x) + 1
+
+
+def divergent_two_hops_up(x):
+    # hop 2: the collective is invisible without summary propagation
+    if jax.process_index() == 0:
+        return _middle(x)
+    return x
+
+
+def _leaf_clock():
+    return time.time()
+
+
+def _stamp():
+    # hop 1 for the nondeterminism rule: returns the leaf's wall clock
+    return _leaf_clock()
+
+
+def seeded_two_hops_up(rng):
+    return jax.random.fold_in(rng, int(_stamp()))

@@ -31,9 +31,35 @@ def main() -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # Bounded cohort bring-up (ISSUE 14 satellite): on 1-core boxes
+    # the loopback-Gloo rendezvous intermittently wedges BOTH workers
+    # during bring-up — inside jax.distributed.initialize (it blocks
+    # for the peer connect) or at the first collective right after it
+    # (PR 12 postscript — it used to eat the module's 300 s
+    # communicate() wall per attempt and error 4 tests). The
+    # watchdog's deadline covers init + a probe collective and
+    # hard-exits this worker on a wedge; the parent fixture's
+    # fresh-port transient_distributed retry re-forms the cohort.
+    from code2vec_tpu.parallel.compat import (PhaseDeadline,
+                                              first_collective_barrier)
     from code2vec_tpu.parallel.distributed import maybe_initialize
-    maybe_initialize(coordinator_address=f"127.0.0.1:{port}",
-                     num_processes=2, process_id=pid)
+    _log = lambda m: print(m, flush=True)  # noqa: E731
+    first_collective_barrier(
+        timeout_s=90.0,
+        setup_fn=lambda: maybe_initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=2, process_id=pid),
+        log=_log)
+    # ...and the same protection for every phase AFTER bring-up: the
+    # transport race can wedge a later collective too (observed mid-
+    # workload on this box). Each beat re-arms a 120 s deadline —
+    # ~4x the loaded per-phase cost — so a wedge anywhere surfaces as
+    # a fast retryable death, never a burned communicate() wall.
+    watchdog = PhaseDeadline(timeout_s=120.0, log=_log)
+    # device placement (shard_params/shard_opt_state device_puts cross
+    # the process boundary) is wedge-prone but compile-free: default
+    # 120 s bound (observed: a real wedge here burned a 240 s phase)
+    watchdog.beat("shard-state")
 
     import jax.numpy as jnp
     import numpy as np
@@ -67,10 +93,16 @@ def main() -> None:
     batch = shard_batch(mesh, local, process_local=True)
     assert batch[0].shape[0] == 16, batch[0].shape  # B scales with hosts
 
+    # the step call carries the big XLA compiles: a loaded 1-core box
+    # can legitimately take >100 s here (compat docstring), so this
+    # phase gets extra headroom — still under the 300 s communicate
+    # wall
+    watchdog.beat("train-step", timeout_s=240.0)
     step = make_train_step(dims, optimizer, compute_dtype=jnp.float32)
     params, opt_state, loss = step(params, opt_state, batch,
                                    jax.random.PRNGKey(7))
 
+    watchdog.beat("eval-step")
     # --- eval: identical batch on both hosts; global batch stays 8 ---
     eval_local = example_batch(seed=99, dims=dims, batch=8)
     eval_batch = shard_batch(mesh, eval_local, process_local=False)
@@ -79,6 +111,7 @@ def main() -> None:
     loss_sum, topk_ids, _ = eval_step(params, eval_batch)
     topk_host = fetch_global(topk_ids)
 
+    watchdog.beat("checkpoint")
     # --- checkpoint save: orbax saves are collectives, every process
     # participates (jax_model.save does the same in train()) ---
     from code2vec_tpu.training import checkpoint as ckpt
@@ -99,6 +132,7 @@ def main() -> None:
         jnp.sum(fetch_global(v).astype(np.float64))
         for v in restored["params"].values()))
 
+    watchdog.beat("async-checkpoint")
     # --- async checkpoint writer: the per-process call-order
     # discipline exercised with REAL processes (ISSUE 9 satellite).
     # Each process runs its OWN writer thread; orbax saves are
@@ -146,6 +180,7 @@ def main() -> None:
     checksum = float(sum(jnp.sum(fetch_global(v).astype(np.float64))
                          for v in params.values()))
 
+    watchdog.beat("ring-attention")
     # --- ring attention across the REAL process boundary. Mesh layout
     # matters: jax.devices() reshapes to (dcn, data, ctx, model), and
     # process 0 owns devices 0-3 — with data>1 the ctx pairs would stay
@@ -162,6 +197,7 @@ def main() -> None:
     ring_max_err = float(jnp.max(jnp.abs(
         ring_out - dense_oracle(q, kk, vv, rmask))))
 
+    watchdog.beat("sharded-evaluate")
     # --- model-level SHARDED evaluate: each host parses a disjoint shard
     # of the eval file; metric partials allreduce at the end
     # (jax_model.evaluate multi-host path) ---
@@ -175,6 +211,7 @@ def main() -> None:
     model = Code2VecModel(cfg)
     eval_res = model.evaluate()
 
+    watchdog.close()
     np.savez(os.path.join(out_dir, f"proc{pid}.npz"),
              loss=float(loss), checksum=checksum,
              restored_checksum=restored_checksum,

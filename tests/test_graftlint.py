@@ -1,7 +1,9 @@
-"""graftlint (ISSUE 4): the suite is tier-1 — the repo must lint clean
-against its checked-in baseline, every rule must catch its fixture
-true-positives and ignore its tricky false-positives, and the whole
-thing must run fast (< 30 s) WITHOUT importing JAX or TensorFlow
+"""graftlint (ISSUE 4; interprocedural since ISSUE 14): the suite is
+tier-1 — the repo must lint clean against its checked-in baseline,
+every rule must catch its fixture true-positives and ignore its tricky
+false-positives, and the whole two-pass scan (per-file rules + the
+call-summary fixpoint) must run fast (< 60 s) WITHOUT importing JAX or
+TensorFlow
 (blocked-module subprocess proof, the test_obs_guard.py pattern — a
 linter that drags in a backend couldn't gate commits on a CPU image).
 """
@@ -24,13 +26,14 @@ from tools.graftlint.rules.test_markers import (TestMarkerRule,
 REPO = REPO_ROOT
 FIXTURES = os.path.join(REPO, "tests", "graftlint_fixtures")
 
-# every registered rule — extended by the ISSUE 12 dataflow trio; the
-# no-baseline gate below runs ALL of them, so serving/obs/training/
-# ops/parallel/resilience must come up clean under the new rules too
+# every registered rule — extended by the ISSUE 12 dataflow trio and
+# the ISSUE 14 interprocedural pair; the no-baseline gate below runs
+# ALL of them, so serving/obs/training/ops/parallel/resilience must
+# come up clean under the new rules too
 ALL_RULES = {"host-sync-in-hot-path", "retrace-hazard",
              "lock-discipline", "config-drift", "test-marker-hygiene",
              "swallowed-error", "donation-safety", "thread-handoff",
-             "resource-leak"}
+             "resource-leak", "spmd-divergence", "nondeterminism"}
 
 
 def _fx(name):
@@ -54,17 +57,18 @@ def repo_findings(repo_scan):
     return repo_scan[0]
 
 
-def test_all_nine_rules_registered():
+def test_all_eleven_rules_registered():
     assert set(all_rules()) == ALL_RULES
+    assert len(ALL_RULES) == 11
 
 
 def test_full_scan_performance(repo_scan):
-    """Tier-1 guard (ISSUE 12 satellite): the full-repo scan with all
-    9 rules must stay comfortably inside the pre-commit budget — the
-    dataflow core's one-pass loop fixpoint is O(statements) per
-    function, and this bound is how we notice if a rule change quietly
-    goes quadratic. Generous: the scan measures ~2-4 s on a loaded CI
-    core."""
+    """Tier-1 guard (ISSUE 12 satellite, re-baselined for the ISSUE 14
+    TWO-PASS scan): the full-repo scan with all 11 rules — including
+    the summary pass + call-graph fixpoint — must stay comfortably
+    inside the pre-commit budget; this bound is how we notice if a
+    rule change (or the fixpoint) quietly goes quadratic. Generous:
+    the two-pass scan measures ~8-10 s on a loaded CI core."""
     _findings, elapsed = repo_scan
     assert elapsed < 60.0, f"full graftlint scan took {elapsed:.1f}s"
 
@@ -233,6 +237,170 @@ def test_resource_leak_fixtures():
     assert fp == [], "\n".join(f.render() for f in fp)
 
 
+def test_spmd_divergence_fixtures():
+    """ISSUE 14 acceptance: every collective-under-divergent-control
+    shape flags (direct, assigned-rank, early exit, exception handler,
+    IfExp arm, writer submit, per-host loop, and the summary-hop
+    reaches); the uniform/audited shapes stay quiet."""
+    tp = _rule_findings("spmd-divergence", [_fx("spmd_tp.py")])
+    assert {f.symbol for f in tp} == {
+        "branch_on_process_index", "branch_on_assigned_rank",
+        "divergent_early_exit", "collective_in_exception_handler",
+        "interprocedural_reach", "divergent_test_via_summary",
+        "ternary_collective", "RankedSaver.maybe_submit",
+        "loop_over_local_devices"}
+    msgs = " ".join(f.message for f in tp)
+    assert "cohort deadlocks" in msgs
+    for needle in ("collective `psum`", "shard_map",
+                   "exception handler", "early exit",
+                   "async checkpoint writer"):
+        assert needle in msgs, needle
+    # the divergent-site line/via chain is context, NOT baseline
+    # identity (line moves must not resurrect entries)
+    assert all("divergent control:" in f.detail for f in tp)
+    # the one-hop reach names the callee the effect came through
+    via = [f for f in tp if f.symbol == "interprocedural_reach"]
+    assert via and "inherited via _sync_helper" in via[0].detail
+    fp = _rule_findings("spmd-divergence", [_fx("spmd_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
+def test_nondeterminism_fixtures():
+    """ISSUE 14 acceptance: wall-clock/global-rng/fs-order/set-order/
+    id() into rng seams, tensors, seed kwargs and checkpointed state
+    all flag; the sanctioned seams (step-keyed fold_in, seeded
+    instance streams, sorted listings, set membership, telemetry
+    timestamps, per-host row tags, dither_from_index) stay quiet."""
+    tp = _rule_findings("nondeterminism", [_fx("nondet_tp.py")])
+    assert {f.symbol for f in tp} == {
+        "clock_seeded_key", "clock_fold_in", "global_rng_tensor",
+        "set_order_tensor", "listing_order_rows",
+        "glob_into_checkpoint", "loop_var_into_checkpoint",
+        "seed_kwarg_from_clock", "interprocedural_source",
+        "object_identity_seed"}
+    msgs = " ".join(f.message for f in tp)
+    for needle in ("wall clock", "global random stream",
+                   "set iteration order", "filesystem listing order",
+                   "rng seam", "tensor construction",
+                   "checkpointed state", "resume-parity"):
+        assert needle in msgs, needle
+    # the source site rides in `detail` (outside baseline identity);
+    # the one-hop source names the returning callee
+    assert all("source:" in f.detail for f in tp)
+    hop = [f for f in tp if f.symbol == "interprocedural_source"]
+    assert hop and "returned by `_wall_clock_stamp`" in hop[0].detail
+    fp = _rule_findings("nondeterminism", [_fx("nondet_fp.py")])
+    assert fp == [], "\n".join(f.render() for f in fp)
+
+
+# ---- the summary layer itself (ISSUE 14 satellite) ----
+
+def test_nested_helper_keeps_hot_path_reach(tmp_path):
+    """Review round: excluding nested defs from GLOBAL resolution must
+    not cost the lexical reach — a host sync in a helper nested inside
+    a jitted step still flags (nested defs resolve through the
+    enclosing frame's scope chain), while a nested def can no longer
+    shadow a same-named module-level def repo-wide."""
+    p = tmp_path / "hot.py"
+    p.write_text(
+        "import jax\n"
+        "import numpy as np\n\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    def fetch(v):\n"
+        "        return float(np.asarray(v))\n"
+        "    return fetch(x)\n")
+    fs = run_lint([str(p)], root=str(tmp_path),
+                  rules=["host-sync-in-hot-path"])
+    assert {f.symbol for f in fs} == {"fetch"}, \
+        "\n".join(f.render() for f in fs)
+
+
+def test_summaries_two_hop_reach():
+    """A hazard TWO resolved calls below the divergent/sinking site
+    fires only through the propagated summaries — nothing
+    intraprocedural can see it."""
+    spmd = _rule_findings("spmd-divergence",
+                          [_fx("summaries_twohop_tp.py")])
+    assert {f.symbol for f in spmd} == {"divergent_two_hops_up"}
+    assert "inherited via _middle" in spmd[0].detail
+    nondet = _rule_findings("nondeterminism",
+                            [_fx("summaries_twohop_tp.py")])
+    assert {f.symbol for f in nondet} == {"seeded_two_hops_up"}
+    assert "returned by `_stamp`" in nondet[0].detail
+
+
+def test_summaries_terminate_on_cycles():
+    """Recursion and mutual call cycles must converge (facts are
+    monotone finite sets): summaries come back, clean cycles stay
+    empty, and an effect inside a cycle propagates to every member —
+    while the uniform caller produces no finding."""
+    from tools.graftlint.core import Scan
+
+    ctx = FileContext(_fx("summaries_cycle_fp.py"), REPO)
+    scan = Scan([ctx], REPO)
+    sums = {s.qualname: s for s in scan.summaries.values()}
+    assert sums["clean_self_recursive"].collective == {}
+    assert sums["ping"].collective == {} and sums["pong"].nondet == {}
+    for member in ("cyc_a", "cyc_b", "uniform_cycle_user"):
+        assert any("psum" in lbl for lbl in sums[member].collective), \
+            member
+    for rule in ("spmd-divergence", "nondeterminism"):
+        fs = _rule_findings(rule, [_fx("summaries_cycle_fp.py")])
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_summaries_record_escaping_and_donated_params():
+    """The ISSUE 14 summary spec: params that escape (thread/queue/
+    attribute/closure) and params the body donates are recorded, and
+    donation propagates so donation-safety sees through wrappers."""
+    import textwrap
+
+    from tools.graftlint.core import Scan
+
+    src = textwrap.dedent("""\
+        import jax, threading, queue
+
+        step = jax.jit(lambda p, o: (p, o), donate_argnums=(0, 1))
+
+        def wrapper(params, opt, batch):
+            return step(params, opt)
+
+        def two_hop_wrapper(params, opt, batch):
+            return wrapper(params, opt, batch)
+
+        def escapes(params, q, store):
+            q.put(params)
+            store.latest = params
+            def closure():
+                return params
+            return closure
+
+        def caller(params, opt, batch, save):
+            new_p, new_o = two_hop_wrapper(params, opt, batch)
+            save(params)  # read-after-donation, two wrappers deep
+            return new_p, new_o
+    """)
+    path = os.path.join(REPO, "tests", "graftlint_fixtures")
+    tmp = os.path.join(path, "_summary_params_tmp.py")
+    with open(tmp, "w") as f:
+        f.write(src)
+    try:
+        ctx = FileContext(tmp, REPO)
+        scan = Scan([ctx], REPO)
+        sums = {s.qualname: s for s in scan.summaries.values()}
+        assert sums["wrapper"].donated_params == {0: "params", 1: "opt"}
+        assert sums["two_hop_wrapper"].donated_params == {
+            0: "params", 1: "opt"}
+        assert sums["escapes"].escaping_params == {"params"}
+        dn = run_lint([tmp], root=REPO, rules=["donation-safety"])
+        assert [f.symbol for f in dn] == ["caller"], \
+            "\n".join(f.render() for f in dn)
+        assert "`params` is read after being donated" in dn[0].message
+    finally:
+        os.remove(tmp)
+
+
 def test_dataflow_sees_defs_in_match_and_async_with():
     """Regression (review): a def nested in a match-case arm or an
     async-with body is still a frame — a span leak there must flag."""
@@ -325,12 +493,28 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     bad_resilience = Finding("swallowed-error",
                              "code2vec_tpu/resilience/retry.py",
                              1, "m", "s")
+    # ISSUE 14 satellite: the new interprocedural rules are refused
+    # entries under training/, parallel/ and resilience/ from day one —
+    # a divergent collective or a nondeterministic parity leak in
+    # those trees is a bug to fix, never debt to grandfather
+    bad_spmd = Finding("spmd-divergence",
+                       "code2vec_tpu/training/checkpoint.py", 1, "m", "s")
+    bad_spmd_par = Finding("spmd-divergence",
+                           "code2vec_tpu/parallel/distributed.py",
+                           1, "m", "s")
+    bad_nondet = Finding("nondeterminism",
+                         "code2vec_tpu/resilience/faults.py", 1, "m", "s")
+    bad_nondet_tr = Finding("nondeterminism",
+                            "code2vec_tpu/training/sparse_update.py",
+                            1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
     refused = baseline_mod.write(
-        [bad, bad_training, bad_ops, bad_parallel, bad_resilience, ok],
+        [bad, bad_training, bad_ops, bad_parallel, bad_resilience,
+         bad_spmd, bad_spmd_par, bad_nondet, bad_nondet_tr, ok],
         path)
     assert refused == [bad, bad_training, bad_ops, bad_parallel,
-                       bad_resilience]
+                       bad_resilience, bad_spmd, bad_spmd_par,
+                       bad_nondet, bad_nondet_tr]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 
@@ -349,7 +533,8 @@ def test_cli_runs_clean_without_jax_or_tf(tmp_path):
     """The pre-commit gate (`python -m tools.graftlint`) must exit 0 on
     the current tree with BOTH jax and tensorflow import-blocked: the
     AST walk may not touch either (tier-1 runs on bare CPU images, and
-    the < 30 s budget leaves no room for a backend init)."""
+    the scan-perf budget leaves no room for a backend init). The
+    timeout tracks the two-pass (ISSUE 14) scan-perf guard's bound."""
     blocker = tmp_path / "block"
     blocker.mkdir()
     for mod in ("jax", "tensorflow"):
@@ -361,12 +546,47 @@ def test_cli_runs_clean_without_jax_or_tf(tmp_path):
                                 if env.get("PYTHONPATH") else []))
     r = subprocess.run([sys.executable, "-m", "tools.graftlint"],
                        cwd=REPO, env=env, capture_output=True,
-                       text=True, timeout=30)
+                       text=True, timeout=60)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 findings" in r.stdout
-    # ALL nine rules ran under the import block — the dataflow core
-    # (ISSUE 12) must hold parse-never-import like everything else
+    # ALL eleven rules ran under the import block — the dataflow core
+    # (ISSUE 12) and the two-pass summary layer (ISSUE 14) must hold
+    # parse-never-import like everything else
     assert f"rules: {len(ALL_RULES)})" in r.stdout
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    """ISSUE 14 satellite: `--format sarif` emits valid SARIF 2.1.0 —
+    all 11 rules in the driver table, one result per NEW finding with
+    rule id + uri + startLine — while text/json stay untouched. Exit
+    semantics match json (1 on findings)."""
+    from tools.graftlint.__main__ import main
+
+    rc = main(["--format", "sarif", "--rules", "config-drift",
+               "code2vec_tpu"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == ALL_RULES
+    assert run["results"] == []
+    # a planted finding renders as a SARIF result
+    p = tmp_path / "bad.py"
+    p.write_text("def f():\n"
+                 "    try:\n"
+                 "        g()\n"
+                 "    except Exception:\n"
+                 "        pass\n")
+    rc = main(["--format", "sarif", "--root", str(tmp_path),
+               "--baseline", str(tmp_path / "none.json"), str(p)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    res = doc["runs"][0]["results"]
+    assert len(res) == 1 and res[0]["ruleId"] == "swallowed-error"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "bad.py"
+    assert loc["region"]["startLine"] == 4
 
 
 def test_cli_json_format_and_rule_selection(capsys):
@@ -462,6 +682,182 @@ def test_cli_changed_mode_gates_a_diff(tmp_path, capsys):
     assert main(["--changed", "tools"]) == 2
     assert main(["--changed", "--write-baseline"]) == 2
     capsys.readouterr()
+
+
+def test_cli_changed_mode_is_summary_aware(tmp_path, capsys):
+    """ISSUE 14 satellite, both directions of the one-hop blast
+    radius: (a) a changed CALLEE body can change a CALLER's findings
+    one hop up, so the gate re-lints the callers' files; (b) a changed
+    CALL SITE can only be judged with its callee's summary present, so
+    the gate pulls the callees' files into the scan set too — editing
+    ONLY the caller of an unchanged collective helper must still flag
+    the new divergent call (review round: the gate used to pass what
+    the full scan then failed on)."""
+    from tools.graftlint.__main__ import main, summary_scope
+    repo = str(tmp_path / "r")
+    os.makedirs(os.path.join(repo, "tools"))
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", *args], cwd=repo,
+                       check=True, capture_output=True)
+
+    def write(rel, text):
+        with open(os.path.join(repo, rel), "w") as f:
+            f.write(text)
+
+    git("init", "-q")
+    write("tools/callee.py", "def helper(x):\n    return x\n")
+    write("tools/caller.py",
+          "from tools.callee import helper\n\n\n"
+          "def top(x):\n"
+          "    try:\n"
+          "        return helper(x)\n"
+          "    except Exception:\n"
+          "        pass\n")
+    write("tools/unrelated.py", "def lonely():\n    return 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # (a) only the callee changes; the planted finding is in caller.py
+    write("tools/callee.py", "def helper(x):\n    return x + 1\n")
+    assert summary_scope(repo, ["tools/callee.py"])[0] == [
+        "tools/caller.py"]
+    rc = main(["--changed", "--root", repo])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "caller/callee file" in out
+    assert "tools/caller.py" in out and "swallowed-error" in out
+    assert "unrelated" not in out  # one hop, not the whole repo
+    git("add", "-A")
+    git("commit", "-qm", "callee change")
+    # (b) only the CALLER changes: a new process_index() branch around
+    # the unchanged collective helper — resolvable only because the
+    # gate pulls sync.py into the scan set
+    write("tools/sync.py",
+          "import jax\n\n\n"
+          "def sync_helper(x):\n"
+          "    return jax.lax.psum(x, 'data')\n")
+    git("add", "-A")
+    git("commit", "-qm", "fix finding; add helper")
+    write("tools/caller.py",
+          "import jax\n\nfrom tools.sync import sync_helper\n\n\n"
+          "def top(x):\n"
+          "    if jax.process_index() == 0:\n"
+          "        return sync_helper(x)\n"
+          "    return x\n")
+    assert summary_scope(repo, ["tools/caller.py"])[0] == [
+        "tools/sync.py"]
+    rc = main(["--changed", "--root", repo])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "spmd-divergence" in out and "tools/caller.py" in out
+    # per-file-rules-only runs skip the expansion (the fast path the
+    # gate exists to preserve) — and therefore don't flag
+    rc = main(["--changed", "--root", repo,
+               "--rules", "swallowed-error"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "caller/callee" not in out
+    # (b') TRANSITIVE closure (review round 3): A calls B calls C;
+    # change only the LEAF C to grow the collective — the divergent
+    # call in UNCHANGED A is indicted through two summary hops, so the
+    # gate must pull both B's and A's files
+    git("add", "-A")
+    git("commit", "-qm", "divergent caller")
+    write("tools/leaf.py", "def leaf(x):\n    return x\n")
+    write("tools/mid.py",
+          "from tools.leaf import leaf\n\n\n"
+          "def middle(x):\n"
+          "    return leaf(x)\n")
+    write("tools/caller.py",
+          "import jax\n\nfrom tools.mid import middle\n\n\n"
+          "def top(x):\n"
+          "    if jax.process_index() == 0:\n"
+          "        return middle(x)\n"
+          "    return x\n")
+    os.remove(os.path.join(repo, "tools", "sync.py"))
+    git("add", "-A")
+    git("commit", "-qm", "clean chain")
+    write("tools/leaf.py",
+          "import jax\n\n\n"
+          "def leaf(x):\n"
+          "    return jax.lax.psum(x, 'data')\n")
+    extra, _amb = summary_scope(repo, ["tools/leaf.py"])
+    assert set(extra) >= {"tools/mid.py", "tools/caller.py"}
+    rc = main(["--changed", "--root", repo])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "spmd-divergence" in out and "tools/caller.py" in out
+
+    # (c) subset-resolution bias (review round): a SECOND sync_helper
+    # makes the name ambiguous repo-wide — the full scan refuses to
+    # resolve it, and the --changed subset (which only sees one
+    # definition) must refuse too instead of emitting a phantom
+    # finding tier-1 never shows
+    write("tools/sync.py",
+          "import jax\n\n\n"
+          "def sync_helper(x):\n"
+          "    return jax.lax.psum(x, 'data')\n")
+    write("tools/caller.py",
+          "import jax\n\nfrom tools.sync import sync_helper\n\n\n"
+          "def top(x):\n"
+          "    if jax.process_index() == 0:\n"
+          "        return sync_helper(x)\n"
+          "    return x\n")
+    write("tools/leaf.py", "def leaf(x):\n    return x\n")
+    write("tools/sync2.py",
+          "def sync_helper(x):\n    return x\n")
+    git("add", "-A")
+    git("commit", "-qm", "second helper: name now ambiguous")
+    write("tools/caller.py",
+          "import jax\n\nfrom tools.sync import sync_helper\n\n\n"
+          "def top(x):\n"
+          "    if jax.process_index() == 0:\n"
+          "        return sync_helper(x)\n"
+          "    return x + 0\n")
+    _, ambiguous = summary_scope(repo, ["tools/caller.py"])
+    assert "sync_helper" in ambiguous
+    rc = main(["--changed", "--root", repo])
+    out = capsys.readouterr().out
+    assert rc == 0, out  # matches the full scan's under-reach verdict
+    assert "spmd-divergence" not in out
+
+
+def test_cli_scoped_path_scans_use_the_ambiguity_fence(tmp_path,
+                                                       capsys):
+    """Review round 3: a path-scoped scan (`graftlint tools/sub`) is a
+    subset scan too — a name defined twice repo-wide must not
+    uniqueness-resolve just because the second definition's file sits
+    outside the given paths (the full scan refuses, so the scoped scan
+    must refuse too, or it emits phantom findings tier-1 never shows
+    and the baseline can never grandfather)."""
+    from tools.graftlint.__main__ import main
+    repo = str(tmp_path / "r")
+    os.makedirs(os.path.join(repo, "tools", "sub"))
+
+    def write(rel, text):
+        with open(os.path.join(repo, rel), "w") as f:
+            f.write(text)
+
+    write("tools/sub/helper.py",
+          "import jax\n\n\n"
+          "def sync_helper(x):\n"
+          "    return jax.lax.psum(x, 'data')\n")
+    write("tools/other.py", "def sync_helper(x):\n    return x\n")
+    write("tools/sub/a.py",
+          "import jax\n\nfrom tools.sub.helper import sync_helper\n\n\n"
+          "def top(x):\n"
+          "    if jax.process_index() == 0:\n"
+          "        return sync_helper(x)\n"
+          "    return x\n")
+    # control: with BOTH definitions in the scan set the name is
+    # natively ambiguous and nothing flags
+    assert main(["--root", repo, "tools"]) == 0
+    capsys.readouterr()
+    rc = main(["--root", repo, "tools/sub"])    # scoped: fenced
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "spmd-divergence" not in out
 
 
 def test_cli_scoped_scans_do_not_spam_stale_entries(capsys):

@@ -37,17 +37,24 @@ def two_process_results(tmp_path_factory):
     from code2vec_tpu.resilience import retry as retry_mod
 
     # Gloo over loopback TCP has a documented transient transport race
-    # (compat docstring) — one retry on a fresh port keeps the fixture
-    # from turning a platform hiccup into 6 tier-1 errors. The retry
-    # IS the shared resilience policy (ISSUE 10): the hand-rolled
-    # attempt loop this fixture and tools/multichip_bench.py each
-    # carried lives in code2vec_tpu/resilience/retry.py now. Two
-    # attempts deliberately: each attempt can burn up to its 300 s
-    # communicate() wall on a loaded 1-core container, so a bigger
-    # budget here would spend the tier-1 budget inside ONE fixture
-    # (observed in round 17) — the race is a platform artifact, and
-    # two strikes in a row is rare enough to read as the platform's
-    # verdict for this run.
+    # (compat docstring) — fresh-port retries keep the fixture from
+    # turning a platform hiccup into 6 tier-1 errors. The retry IS the
+    # shared resilience policy (ISSUE 10): the hand-rolled attempt
+    # loop this fixture and tools/multichip_bench.py each carried
+    # lives in code2vec_tpu/resilience/retry.py now. Round 18 (ISSUE
+    # 14 satellite — the PR 12 postscript): the cohort bring-up in
+    # mp_worker.py now runs a BOUNDED first-collective barrier
+    # (compat.first_collective_barrier, 90 s watchdog ->
+    # os._exit(BARRIER_TIMEOUT_EXIT)), so the wedge that used to
+    # freeze BOTH workers at the first Gloo collective and silently
+    # eat a full 300 s communicate() wall per attempt now surfaces as
+    # a fast retryable worker death. That bound is what pays for the
+    # third attempt below: hang attempts cost ~90 s instead of 300 s,
+    # and `max_elapsed_s=330` refuses further retries once the
+    # pathological POST-barrier-hang case (still backstopped by the
+    # 300 s wall) has burned the budget — worst case stays at the old
+    # two-wall ceiling while the common crash/wedge cases get one
+    # more fresh port to recover on.
     def spawn_once():
         out_dir = str(tmp_path_factory.mktemp("mp"))
         port = free_port()
@@ -74,8 +81,8 @@ def two_process_results(tmp_path_factory):
                 for i in range(2)}
 
     return retry_mod.transient_distributed(
-        "two-process-fixture", max_attempts=2,
-        base_delay_s=0.1).call(spawn_once)
+        "two-process-fixture", max_attempts=3,
+        base_delay_s=0.1, max_elapsed_s=330).call(spawn_once)
 
 
 def test_two_processes_agree(two_process_results):

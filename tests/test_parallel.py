@@ -158,3 +158,100 @@ def test_vm_sharded_train_step_matches_single_device():
     ev2 = make_vm_eval_step(dims)(p2, sb)
     np.testing.assert_allclose(float(ev1[0]), float(ev2[0]), rtol=1e-5)
     np.testing.assert_allclose(float(ev1[1]), float(ev2[1]), rtol=1e-5)
+
+
+# ---- bounded first-collective barrier (ISSUE 14 satellite) ----
+
+def test_first_collective_barrier_single_process_skips_probe():
+    """Nothing to rendezvous on one process: the probe is skipped and
+    the watchdog thread is reaped before return (no timer left
+    running). A generous deadline: the assertion is about thread
+    hygiene, not timing."""
+    import threading
+
+    from code2vec_tpu.parallel.compat import first_collective_barrier
+
+    before = threading.active_count()
+    first_collective_barrier(timeout_s=30.0)
+    assert threading.active_count() == before
+
+
+def test_first_collective_barrier_deadline_covers_setup():
+    """The watchdog deadline covers the INIT phase too —
+    jax.distributed.initialize blocks for the peer connect, and a
+    wedge there must trip the same fast exit (the round-18 probe run
+    showed the hang striking before the probe collective)."""
+    import threading
+
+    from code2vec_tpu.parallel.compat import first_collective_barrier
+
+    fired = threading.Event()
+    first_collective_barrier(timeout_s=0.05,
+                             setup_fn=lambda: fired.wait(5.0),
+                             barrier_fn=lambda: None,
+                             on_timeout=fired.set)
+    assert fired.is_set()
+
+
+def test_first_collective_barrier_fast_barrier_cancels_watchdog():
+    """A completing probe must cancel the watchdog — on_timeout never
+    fires even after the deadline would have passed."""
+    import time
+
+    from code2vec_tpu.parallel.compat import first_collective_barrier
+
+    fired = []
+    first_collective_barrier(timeout_s=0.05,
+                             barrier_fn=lambda: None,
+                             on_timeout=lambda: fired.append(1))
+    time.sleep(0.15)
+    assert fired == []
+
+
+def test_first_collective_barrier_wedged_barrier_fires_watchdog():
+    """A wedged probe trips on_timeout at the deadline (the injected
+    stand-in for os._exit(BARRIER_TIMEOUT_EXIT)) — the shape that
+    converts the PR 12 postscript module-eating hang into a fast
+    retryable worker death."""
+    import threading
+
+    from code2vec_tpu.parallel.compat import (BARRIER_TIMEOUT_EXIT,
+                                              first_collective_barrier)
+
+    assert BARRIER_TIMEOUT_EXIT == 19  # the greppable contract
+    fired = threading.Event()
+    first_collective_barrier(timeout_s=0.05,
+                             barrier_fn=lambda: fired.wait(5.0),
+                             on_timeout=fired.set)
+    assert fired.is_set()
+
+
+def test_phase_deadline_beats_rearm_and_close_disarms():
+    """PhaseDeadline: a beaten deadline never fires; close() disarms
+    and reaps; a wedged phase fires with ITS label (the injected
+    stand-in for os._exit)."""
+    import threading
+    import time
+
+    from code2vec_tpu.parallel.compat import PhaseDeadline
+
+    fired = []
+    wd = PhaseDeadline(timeout_s=0.2, on_timeout=fired.append)
+    for phase in ("a", "b", "c"):  # beats inside the deadline re-arm
+        wd.beat(phase)
+        time.sleep(0.05)
+    wd.beat("compile-heavy", timeout_s=1.0)  # per-phase override
+    time.sleep(0.3)  # past the default, inside the override
+    wd.close()
+    time.sleep(0.3)
+    assert fired == []
+
+    hung = threading.Event()
+    wd2 = PhaseDeadline(timeout_s=0.05,
+                        on_timeout=lambda ph: (fired.append(ph),
+                                               hung.set()))
+    wd2.beat("bring-up")
+    wd2.beat("wedged-collective")
+    assert hung.wait(5.0)
+    assert fired == ["wedged-collective"]
+    wd2.close()

@@ -14,8 +14,19 @@ on any platform in well under the 30 s budget. `tests/test_graftlint.py`
 proves the no-JAX/no-TF property with the blocked-module subprocess
 pattern from tests/test_obs_guard.py.
 
+ISSUE 14 tentpole: summary-based interprocedural analysis — a first
+pass computes per-function summaries (collective effects,
+nondeterminism draws/returns, per-host identity returns, escaping /
+donated params; tools/graftlint/dataflow.py `compute_summaries`), a
+worklist fixpoint widens them over the shared heuristic call graph
+(core.Scan), and the rules see one call hop deeper: `spmd-divergence`
+(collectives under process-divergent control — the distributed-
+deadlock class) and `nondeterminism` (wall clock / global rng /
+fs-or-set iteration order / id()-hash() flowing into the
+resume-parity surface).
+
 Usage:
-    python -m tools.graftlint [--format json] [--rules r1,r2] [paths]
+    python -m tools.graftlint [--format json|sarif] [--rules r1,r2] [paths]
 Suppression:
     # graftlint: disable=<rule>[,<rule>...]       (this line / next line)
     # graftlint: disable-file=<rule>[,<rule>...]  (whole file)

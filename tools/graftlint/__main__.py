@@ -14,7 +14,17 @@ reported but do not fail the run — `--write-baseline` drops them.
 (plus untracked files) reports under the default scan set — the
 pre-commit gate stops paying the full-repo scan on every commit; the
 full scan still runs as tier-1 (tests/test_graftlint.py), so repo-wide
-rules (call-graph reachability, config drift) lose nothing.
+rules (call-graph reachability, config drift) lose nothing. Since the
+summary layer (ISSUE 14) made a callee's BODY able to change a
+caller's findings (a function growing a collective effect indicts
+every divergent call site one hop up) — and a changed CALL SITE can
+only be judged with its callee's summary in the scan set — `--changed`
+is summary-aware: it re-lints the changed files PLUS the files holding
+their direct callers and callees (one cheap parse of the scan set
+finds them; no rules run on anything else).
+
+`--format sarif` emits SARIF 2.1.0 for CI annotation / editor ingest;
+the `json` and `text` contracts are unchanged.
 """
 
 from __future__ import annotations
@@ -24,12 +34,12 @@ import json
 import os
 import subprocess
 import sys
-from typing import List
+from typing import Dict, List
 
 from tools.graftlint import baseline as baseline_mod
 from tools.graftlint.core import (DEFAULT_PATHS, EXCLUDE_DIRS,
-                                  REPO_ROOT, all_rules, iter_py_files,
-                                  run_lint)
+                                  FileContext, REPO_ROOT, Rule, Scan,
+                                  all_rules, iter_py_files, run_lint)
 
 
 def changed_py_files(root: str, base: str = "HEAD") -> List[str]:
@@ -60,6 +70,113 @@ def changed_py_files(root: str, base: str = "HEAD") -> List[str]:
     return kept
 
 
+def _parse_default_set(root: str) -> Scan:
+    """One rule-free parse of the default scan set (missing dirs are
+    skipped — hermetic test repos carry only `tools/`)."""
+    present = [d for d in DEFAULT_PATHS
+               if os.path.isdir(os.path.join(root, d))]
+    ctxs = []
+    for path in iter_py_files(present, root):
+        try:
+            ctxs.append(FileContext(path, root))
+        except SyntaxError:
+            continue  # the lint run itself reports parse errors
+    return Scan(ctxs, root)
+
+
+def _wants_scan(rules, selected) -> bool:
+    """True when any selected rule overrides `check_scan` — only those
+    can see across call boundaries, so only they need the subset-scan
+    soundness machinery."""
+    return any(type(rules[r]).check_scan is not Rule.check_scan
+               for r in (selected or list(rules)))
+
+
+def _full_set_ambiguous(scan: Scan) -> frozenset:
+    """Function names the FULL scan set defines more than once. A
+    subset scan must refuse to uniqueness-resolve these — with the
+    other definition's file outside the subset the name LOOKS unique
+    and would resolve to the wrong def, producing phantom findings
+    tier-1 never emits (core.CallGraph docstring)."""
+    return frozenset(name for name, hits in scan.graph.by_name.items()
+                     if len(hits) > 1)
+
+
+def summary_scope(root: str, changed_rel: List[str]
+                  ) -> "tuple[List[str], frozenset]":
+    """The context a `--changed` subset scan needs to agree with the
+    full scan: (extra_files, ambiguous_names).
+
+    `extra_files` is the TRANSITIVE closure of caller files above the
+    diff (a changed body's new effect propagates up arbitrarily many
+    summary hops — A→B→C with C growing a collective indicts a
+    divergent call in A) plus the transitive callee files below the
+    diff and below every pulled-in caller (a call site can only be
+    judged with its callee's full summary CHAIN in the scan set).
+    Leaf-ish diffs stay cheap; a hub-file diff honestly approaches the
+    full scan, which is the soundness floor. `ambiguous_names` is the
+    subset-resolution fence (`_full_set_ambiguous`)."""
+    scan = _parse_default_set(root)
+    changed = set(changed_rel)
+    fwd: dict = {}
+    rev: dict = {}
+    for fn in scan.functions:
+        for callee in scan.graph.callees(fn):
+            if callee.ctx.rel != fn.ctx.rel:
+                fwd.setdefault(fn.ctx.rel, set()).add(callee.ctx.rel)
+                rev.setdefault(callee.ctx.rel, set()).add(fn.ctx.rel)
+    out: set = set()
+    frontier = set(changed)
+    while frontier:  # transitive callers
+        frontier = {caller for f in frontier
+                    for caller in rev.get(f, ())
+                    if caller not in out and caller not in changed}
+        out |= frontier
+    seen = set(changed) | out
+    frontier = set(seen)
+    while frontier:  # transitive callees (of the diff AND its callers)
+        frontier = {callee for f in frontier
+                    for callee in fwd.get(f, ())
+                    if callee not in seen}
+        seen |= frontier
+        out |= frontier
+    return sorted(out - changed), _full_set_ambiguous(scan)
+
+
+def to_sarif(new, rules: Dict[str, object], grandfathered: int,
+             stale: List[dict]) -> dict:
+    """Minimal SARIF 2.1.0: one run, the registered rules as the tool
+    driver's rule table, one result per NEW finding (grandfathered /
+    stale counts ride in run properties — SARIF consumers only need
+    the actionable set)."""
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://example.invalid/graftlint#static-analysis",
+                "rules": [{"id": name,
+                           "shortDescription": {"text": rule.description}}
+                          for name, rule in sorted(rules.items())],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "warning",
+                "message": {"text": f.message + (
+                    f" ({f.detail})" if f.detail else "")},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            } for f in new],
+            "properties": {"grandfathered": grandfathered,
+                           "stale_baseline": stale},
+        }],
+    }
+
+
 def main(argv: List[str] = None) -> int:
     rules = all_rules()
     p = argparse.ArgumentParser(
@@ -69,7 +186,8 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
                    help=f"files/dirs to scan (default: "
                         f"{' '.join(DEFAULT_PATHS)})")
-    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
     p.add_argument("--rules", default=None,
                    help="comma-separated subset of: "
                         + ", ".join(sorted(rules)))
@@ -98,6 +216,7 @@ def main(argv: List[str] = None) -> int:
                   f"(have: {', '.join(sorted(rules))})", file=sys.stderr)
             return 2
 
+    ambiguous: frozenset = frozenset()
     if args.changed:
         if args.paths != list(DEFAULT_PATHS):
             print("--changed computes its own file list; drop the "
@@ -117,13 +236,38 @@ def main(argv: List[str] = None) -> int:
             if args.format == "json":
                 print(json.dumps({"findings": [], "grandfathered": 0,
                                   "stale_baseline": []}, indent=2))
+            elif args.format == "sarif":
+                print(json.dumps(to_sarif([], rules, 0, []), indent=2))
             else:
                 print(f"graftlint: no changed .py files vs {args.base}"
                       " — 0 findings")
             return 0
+        # summary-aware gate (module docstring): a changed body can
+        # change findings any number of summary hops up, and a changed
+        # call site needs its callee summary chain present — re-lint
+        # the transitive caller/callee files too, refusing subset-only
+        # uniqueness resolution. Skipped entirely when no selected
+        # rule consults the scan (a per-file-rules-only run can't see
+        # across call boundaries, so the expansion would only slow the
+        # fast path).
+        if _wants_scan(rules, selected):
+            extra, ambiguous = summary_scope(args.root, args.paths)
+            if extra and args.format == "text":
+                print(f"graftlint: --changed re-linting {len(extra)} "
+                      f"caller/callee file(s) too "
+                      "(summary-aware gate)")
+            args.paths = args.paths + extra
+    elif sorted(args.paths) != sorted(DEFAULT_PATHS) \
+            and _wants_scan(rules, selected):
+        # a path-scoped scan is a subset scan too: without the fence
+        # it could uniqueness-resolve a name the full scan set defines
+        # twice (the other file being outside the given paths) and
+        # emit phantom findings tier-1 never shows
+        ambiguous = _full_set_ambiguous(_parse_default_set(args.root))
 
     try:
-        findings = run_lint(args.paths, root=args.root, rules=selected)
+        findings = run_lint(args.paths, root=args.root, rules=selected,
+                            ambiguous_names=ambiguous)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2
@@ -174,6 +318,9 @@ def main(argv: List[str] = None) -> int:
             "grandfathered": len(old),
             "stale_baseline": stale,
         }, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(new, rules, len(old), stale),
+                         indent=2))
     else:
         for f in new:
             print(f.render())

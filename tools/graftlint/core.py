@@ -124,7 +124,9 @@ class FileContext:
 class Rule:
     """One named check. Per-file rules implement `check_file`; rules
     needing the whole scan set (call graphs, cross-file consistency)
-    implement `check_repo`. A rule may implement both."""
+    implement `check_repo`; rules consuming the shared function index /
+    call graph / summaries (ISSUE 14) implement `check_scan`. A rule
+    may implement any combination."""
 
     name: str = ""
     description: str = ""
@@ -134,6 +136,9 @@ class Rule:
 
     def check_repo(self, ctxs: Sequence[FileContext],
                    root: str) -> Iterable[Finding]:
+        return ()
+
+    def check_scan(self, scan: "Scan") -> Iterable[Finding]:
         return ()
 
 
@@ -188,11 +193,15 @@ def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
 
 def run_lint(paths: Sequence[str] = DEFAULT_PATHS,
              root: str = REPO_ROOT,
-             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+             rules: Optional[Sequence[str]] = None,
+             ambiguous_names: frozenset = frozenset()) -> List[Finding]:
     """Parse every file once, run the selected rules, apply inline
     suppressions, return findings sorted by (path, line, rule).
     Baseline filtering is the caller's concern (tools/graftlint/
-    baseline.py) — this returns EVERYTHING the rules see."""
+    baseline.py) — this returns EVERYTHING the rules see.
+    `ambiguous_names` (subset scans — the `--changed` gate) blocks
+    uniqueness resolution for names the FULL scan set defines more
+    than once (CallGraph docstring)."""
     _load_rules()
     selected = [_REGISTRY[r] for r in rules] if rules \
         else list(_REGISTRY.values())
@@ -208,10 +217,12 @@ def run_lint(paths: Sequence[str] = DEFAULT_PATHS,
                 line=e.lineno or 0,
                 message=f"file does not parse: {e.msg}"))
     by_rel = {c.rel: c for c in ctxs}
+    scan = Scan(ctxs, root, ambiguous_names)
     for rule in selected:
         for ctx in ctxs:
             findings.extend(rule.check_file(ctx))
         findings.extend(rule.check_repo(ctxs, root))
+        findings.extend(rule.check_scan(scan))
     kept = []
     for f in findings:
         ctx = by_rel.get(f.path)
@@ -220,6 +231,201 @@ def run_lint(paths: Sequence[str] = DEFAULT_PATHS,
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return kept
+
+
+# ---- the shared repo view: function index + heuristic call graph ----
+#
+# Moved here from rules/host_sync.py (ISSUE 14): the summary layer and
+# both new rule families need the same index and the same name-heuristic
+# resolution, and computing them once per run is what keeps the
+# two-pass scan inside the tier-1 wall bound.
+
+@dataclasses.dataclass
+class FnInfo:
+    """One function definition in the scan set."""
+    ctx: FileContext
+    node: ast.AST           # FunctionDef / AsyncFunctionDef
+    cls: str                # enclosing class name ('' at module level)
+    scope: str = ""         # enclosing DEF chain ('' unless nested in
+    #                         a function: 'outer' / 'outer.inner') —
+    #                         keeps a nested def from colliding with a
+    #                         same-named module-level def in key/
+    #                         resolution (they are different functions
+    #                         with different summaries)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    @property
+    def key(self):
+        return (self.ctx.rel, self.cls, self.scope, self.name)
+
+
+def index_functions(ctxs: Sequence[FileContext]) -> List[FnInfo]:
+    """Every def in the scan set, including ones nested in other defs
+    and inside compound statements (loop bodies, except-import
+    fallbacks, match arms)."""
+    fns: List[FnInfo] = []
+    for ctx in ctxs:
+        stack = [(ctx.tree, "", "")]
+        while stack:
+            node, cls, scope = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name, scope))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    fns.append(FnInfo(ctx, child, cls, scope))
+                    inner = f"{scope}.{child.name}" if scope \
+                        else child.name
+                    stack.append((child, cls, inner))
+                elif isinstance(child, _CONTAINER_STMT_TYPES):
+                    stack.append((child, cls, scope))
+    return fns
+
+
+_CONTAINER_STMT_TYPES = (ast.If, ast.Try, ast.With, ast.AsyncWith,
+                         ast.For, ast.AsyncFor, ast.While,
+                         ast.ExceptHandler) + tuple(
+    getattr(ast, n) for n in ("Match", "match_case") if hasattr(ast, n))
+
+# attribute-call names too generic to resolve by global uniqueness
+# (container/protocol vocabulary — resolving `.get()` to some class's
+# `get` would build fantasy edges)
+GENERIC_ATTRS = frozenset({
+    "get", "put", "items", "keys", "values", "append", "add", "update",
+    "pop", "close", "open", "read", "write", "run", "start", "stop",
+    "join", "split", "copy", "clear", "count", "index", "sort", "submit",
+})
+
+
+class CallGraph:
+    """Name-heuristic call graph over the indexed functions. Resolution
+    policy (under-reach by design — rules/host_sync.py docstring has
+    the rationale): simple names resolve within the module then to a
+    globally-unique def; `self.x(...)` resolves within the class; other
+    attribute calls resolve only when the method name is defined
+    exactly once repo-wide and is not a GENERIC_ATTRS protocol name.
+
+    `ambiguous_names` blocks uniqueness resolution for names known to
+    be multiply-defined OUTSIDE this scan set: a `--changed` subset
+    scan would otherwise resolve a name the full scan leaves ambiguous
+    (the other definition's file not being in the subset), producing
+    phantom findings tier-1 never emits."""
+
+    def __init__(self, fns: List[FnInfo],
+                 ambiguous_names: frozenset = frozenset()):
+        self.fns = fns
+        self.ambiguous = ambiguous_names
+        self.by_key = {f.key: f for f in fns}
+        # GLOBAL resolution tables hold only ADDRESSABLE defs: a def
+        # nested inside another function (f.scope) is not importable/
+        # callable from outside its frame, so letting it shadow (or be
+        # merged with) a same-named module-level def would corrupt
+        # both the summaries and the uniqueness resolution. Nested
+        # defs resolve LEXICALLY instead (self.scoped): callable from
+        # within their enclosing frame's scope chain only — hot
+        # functions keep their reach into nested helpers.
+        self.by_name: Dict[str, List[FnInfo]] = {}
+        self.methods: Dict[tuple, Dict[str, FnInfo]] = {}
+        self.module_fns: Dict[str, Dict[str, FnInfo]] = {}
+        self.scoped: Dict[tuple, Dict[str, FnInfo]] = {}
+        for f in fns:
+            if f.scope:
+                self.scoped.setdefault(
+                    (f.ctx.rel, f.cls, f.scope), {})[f.name] = f
+                continue
+            self.by_name.setdefault(f.name, []).append(f)
+            if f.cls:
+                self.methods.setdefault(
+                    (f.ctx.rel, f.cls), {})[f.name] = f
+            else:
+                self.module_fns.setdefault(f.ctx.rel, {})[f.name] = f
+
+    def _unique(self, name: str) -> Optional[FnInfo]:
+        if name in self.ambiguous:
+            return None
+        hits = self.by_name.get(name, ())
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve_call(self, fn: FnInfo, call: ast.Call) -> Optional[FnInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            # lexical chain first: defs nested in THIS frame, then in
+            # each enclosing frame (Python name resolution order —
+            # locals, enclosing, module)
+            frame = f"{fn.scope}.{fn.name}" if fn.scope else fn.name
+            while frame:
+                hit = self.scoped.get(
+                    (fn.ctx.rel, fn.cls, frame), {}).get(func.id)
+                if hit is not None:
+                    return hit
+                frame = frame.rpartition(".")[0]
+            local = self.module_fns.get(fn.ctx.rel, {}).get(func.id)
+            if local is not None:
+                return local
+            return self._unique(func.id)  # imported def elsewhere
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if is_self_attr(func) is not None and fn.cls:
+                mine = self.methods.get((fn.ctx.rel, fn.cls), {}).get(attr)
+                if mine is not None:
+                    return mine
+            if attr in GENERIC_ATTRS:
+                return None
+            return self._unique(attr)
+        return None
+
+    def callees(self, fn: FnInfo) -> Iterable[FnInfo]:
+        for node in walk_body(fn.node):
+            if isinstance(node, ast.Call):
+                target = self.resolve_call(fn, node)
+                if target is not None:
+                    yield target
+
+
+class Scan:
+    """One lint run's shared repo view. Built once per `run_lint` and
+    handed to every `check_scan` rule; the function index, call graph
+    and per-function summaries (tools/graftlint/dataflow.py) are all
+    computed LAZILY — a rule-scoped run that never touches them pays
+    nothing."""
+
+    def __init__(self, ctxs: Sequence[FileContext], root: str,
+                 ambiguous_names: frozenset = frozenset()):
+        self.ctxs = list(ctxs)
+        self.root = root
+        self.ambiguous_names = ambiguous_names
+        self._functions: Optional[List[FnInfo]] = None
+        self._graph: Optional[CallGraph] = None
+        self._summaries = None
+
+    @property
+    def functions(self) -> List[FnInfo]:
+        if self._functions is None:
+            self._functions = index_functions(self.ctxs)
+        return self._functions
+
+    @property
+    def graph(self) -> CallGraph:
+        if self._graph is None:
+            self._graph = CallGraph(self.functions,
+                                    self.ambiguous_names)
+        return self._graph
+
+    @property
+    def summaries(self):
+        """{fn.key: dataflow.Summary} after interprocedural
+        propagation."""
+        if self._summaries is None:
+            from tools.graftlint import dataflow
+            self._summaries = dataflow.compute_summaries(self)
+        return self._summaries
 
 
 # ---- shared AST helpers (used by several rules) ----

@@ -1,4 +1,5 @@
-"""graftflow: the shared intraprocedural dataflow core (ISSUE 12).
+"""graftflow: the shared dataflow core (ISSUE 12) + the call-summary
+layer (ISSUE 14).
 
 graftlint's first six rules are per-node pattern matchers; the bug
 classes the last five PRs kept fixing by hand — reads of donated
@@ -35,6 +36,22 @@ drops the fact rather than guessing. A dataflow rule that sprays
 plausible-but-wrong findings gets suppressed into uselessness; one
 that only speaks when the chain is airtight gets fixed.
 
+Summaries (ISSUE 14, "one hop deeper, still never import" —
+ARCHITECTURE.md has the design note): `compute_summaries(scan)` runs a
+first pass over the whole scan set computing one `Summary` per
+function — params that escape / are donated, whether the body performs
+a COLLECTIVE EFFECT (lax collectives, shard_map regions,
+jax.distributed init, orbax checkpoint save/restore, the async
+checkpoint writer's submit/wait), and whether it DRAWS NONDETERMINISM
+(wall clock, the unseeded global random/np.random streams, unsorted
+os.listdir/glob results, set iteration order, id()/hash()) or returns
+a per-host process-identity value. A worklist fixpoint then propagates
+the facts along the shared heuristic call graph (core.CallGraph), so
+every rule consulting summaries sees one call hop deeper instead of
+under-reaching at function boundaries. All facts are MONOTONE finite
+sets, so the fixpoint terminates on recursion and call cycles
+(tests/graftlint_fixtures/summaries_cycle_fp.py proves it).
+
 Everything here is pure `ast` + stdlib (the graftlint contract: parse,
 never import).
 """
@@ -42,7 +59,9 @@ never import).
 from __future__ import annotations
 
 import ast
-from typing import Any, Iterable, Iterator, List, Optional, Tuple
+import dataclasses
+from typing import (Any, Callable, Dict, Iterable, Iterator, List,
+                    Optional, Set, Tuple)
 
 # ---- escape lattice ----
 
@@ -426,3 +445,653 @@ def _exec(stmt: ast.AST, v: FlowVisitor, state: Any,
     # anything else (future syntax): treat as an opaque leaf
     v.on_stmt(stmt, state)
     return state
+
+
+# ====================================================================
+# The call-summary layer (ISSUE 14).
+# ====================================================================
+
+def call_trailing(call: ast.Call) -> str:
+    """Trailing name of a call: foo(...) -> 'foo', a.b.c(...) -> 'c'."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _call_base(call: ast.Call) -> str:
+    """Dotted base of an attribute call: a.b.c(...) -> 'a.b'."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return ""
+
+
+# ---- donation vocabulary (shared with rules/donation_safety.py) ----
+
+# the repo's step-factory seams: calling the RESULT donates these
+# positional args (training/steps.py, training/sparse_steps.py,
+# training/vm_steps.py all funnel through one make_* entry each)
+FACTORIES: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "make_train_step": ((0, 1), ()),
+    "make_sparse_train_step": ((0, 1), ()),
+    "make_vm_train_step": ((0, 1), ()),
+}
+
+# assigning from these produces FRESH buffers — immune to alias taint
+SNAPSHOT_CALLS = frozenset({"snapshot_state", "copy", "deepcopy",
+                            "device_get", "asarray", "array"})
+
+JIT_NAMES = frozenset({"jit", "pjit"})
+
+Spec = Tuple[Tuple[int, ...], Tuple[str, ...]]  # (argnums, argnames)
+
+
+def _literal_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def expr_trailing(node: ast.AST) -> str:
+    """Trailing name of a Name/Attribute (non-call) expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def jit_donate_spec(call: ast.Call) -> Optional[Spec]:
+    """The donation spec of a `jit(..., donate_argnums=...)` /
+    `functools.partial(jax.jit, donate_argnums=...)` call, or None."""
+    name = call_trailing(call)
+    if name == "partial":
+        if not (call.args and expr_trailing(call.args[0]) in JIT_NAMES):
+            return None
+    elif name not in JIT_NAMES:
+        return None
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            argnums = _literal_ints(kw.value) or ()
+        elif kw.arg == "donate_argnames":
+            argnames = _literal_strs(kw.value) or ()
+    if argnums or argnames:
+        return (argnums, argnames)
+    return None
+
+
+def donating_value_spec(value: ast.AST) -> Optional[Spec]:
+    """Spec when `value` evaluates to a donating callable: a
+    jit-with-donate call or a step-factory call."""
+    if not isinstance(value, ast.Call):
+        return None
+    spec = jit_donate_spec(value)
+    if spec is not None:
+        return spec
+    if isinstance(value.func, ast.Call):
+        # functools.partial(jax.jit, donate_argnums=...)(f)
+        spec = jit_donate_spec(value.func)
+        if spec is not None:
+            return spec
+    return FACTORIES.get(call_trailing(value))
+
+
+class FileDonors:
+    """File-level donor tables built in one pre-pass: decorated defs,
+    module-scope donor names, and per-class `self.X` donor attrs."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[str, Spec] = {}
+        self.module_names: Dict[str, Spec] = {}
+        self.class_attrs: Dict[Tuple[str, str], Spec] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        spec = jit_donate_spec(dec)
+                        if spec is not None:
+                            self.defs[node.name] = spec
+            elif isinstance(node, ast.ClassDef):
+                for n in ast.walk(node):
+                    if not (isinstance(n, ast.Assign)
+                            and isinstance(n.value, ast.Call)):
+                        continue
+                    spec = donating_value_spec(n.value)
+                    if spec is None:
+                        continue
+                    for t in n.targets:
+                        d = dotted(t)
+                        if d.startswith("self."):
+                            self.class_attrs[(node.name, d)] = spec
+        for stmt in getattr(tree, "body", ()):
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                spec = donating_value_spec(stmt.value)
+                if spec is not None:
+                    for t in stmt.targets:
+                        d = dotted(t)
+                        if d:
+                            self.module_names[d] = spec
+
+
+# ---- nondeterminism / effect source vocabulary ----
+
+# VALUE kinds survive any transform; ORDER kinds are killed by
+# order-insensitive consumers (sorted/len/sum/min/max/any/all)
+ORDER_KINDS = frozenset({"fs-order", "set-order"})
+
+KIND_DESC = {
+    "wall-clock": "the wall clock",
+    "global-rng": "the unseeded global random stream",
+    "fs-order": "unsorted filesystem listing order",
+    "set-order": "set iteration order",
+    "object-identity": "id()/hash() (PYTHONHASHSEED-dependent, "
+                       "differs per process)",
+    "process-identity": "a per-host process-identity value",
+}
+
+_TIME_FNS = frozenset({"time", "time_ns", "monotonic", "monotonic_ns",
+                       "perf_counter", "perf_counter_ns"})
+_DATETIME_FNS = frozenset({"now", "utcnow", "today"})
+_RANDOM_GLOBAL_FNS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "sample",
+    "shuffle", "uniform", "gauss", "normalvariate", "getrandbits",
+    "betavariate", "expovariate", "triangular", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "lognormvariate"})
+_NP_RANDOM_GLOBAL_FNS = frozenset({
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "choice", "shuffle", "permutation", "uniform", "normal",
+    "standard_normal", "binomial", "poisson", "beta", "gamma",
+    "exponential", "bytes"})
+_NP_ALIASES = frozenset({"np", "numpy", "onp"})
+_NP_RANDOM_BASES = frozenset({f"{a}.random" for a in _NP_ALIASES})
+
+# the only calls that provably CARRY iteration order into their
+# result — every other call drops ORDER taint (membership/aggregation
+# consumers like `sorted`/`len`/`x in s` are order-insensitive, and an
+# opaque callee is assumed to be one: under-reach)
+_ORDER_MATERIALIZERS = frozenset({"list", "tuple", "iter", "reversed",
+                                  "enumerate", "zip", "map", "filter"})
+
+# per-host identity reads: the values that differ across the processes
+# of one SPMD program (process_count/device_count are deliberately NOT
+# here — they are cohort-uniform)
+_PROCESS_IDENTITY_FNS = frozenset({
+    "process_index", "host_id", "local_devices", "local_device_count",
+    "getpid", "gethostname", "cohort_world"})
+
+
+def _direct_source(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, description) when `call` directly draws a
+    nondeterministic or per-host value; None otherwise."""
+    name = call_trailing(call)
+    base = _call_base(call)
+    if base == "time" and name in _TIME_FNS:
+        return ("wall-clock", f"time.{name}()")
+    if name in _DATETIME_FNS and base.split(".")[-1] in ("datetime",
+                                                         "date"):
+        return ("wall-clock", f"{base}.{name}()")
+    if base == "random" and name in _RANDOM_GLOBAL_FNS:
+        return ("global-rng", f"random.{name}()")
+    if base in _NP_RANDOM_BASES and name in _NP_RANDOM_GLOBAL_FNS:
+        return ("global-rng", f"{base}.{name}()")
+    if (base == "os" and name in ("listdir", "scandir")) \
+            or (base == "glob" and name in ("glob", "iglob")):
+        return ("fs-order", f"{base}.{name}()")
+    if isinstance(call.func, ast.Name) and call.func.id in ("id", "hash") \
+            and call.args:
+        return ("object-identity", f"{call.func.id}()")
+    if isinstance(call.func, ast.Name) \
+            and call.func.id in ("set", "frozenset"):
+        return ("set-order", f"{call.func.id}(...) iteration order")
+    if name in _PROCESS_IDENTITY_FNS:
+        return ("process-identity", f"{name}()")
+    return None
+
+
+Taint = Dict[str, Tuple[int, str]]  # kind -> (line, description)
+
+
+def _merge(into: Taint, frm: Taint) -> None:
+    for k, v in frm.items():
+        into.setdefault(k, v)
+
+
+def expr_nondet(expr: Optional[ast.AST], state: Dict[str, Taint],
+                call_kinds: Optional[Callable[[ast.Call], Taint]] = None
+                ) -> Taint:
+    """The taint kinds an expression's VALUE carries: direct sources
+    plus reads of tainted names in `state`, with ORDER kinds killed by
+    order-insensitive consumers (`sorted(os.listdir(d))` is clean;
+    `list(set(x))` is not). `call_kinds` is the interprocedural hook —
+    the nondeterminism rule passes a resolver that consults callee
+    summaries (`returns_nondet`), the summary pass itself passes None
+    (propagation happens in the fixpoint instead)."""
+    if expr is None:
+        return {}
+    if isinstance(expr, ast.Call):
+        out: Taint = {}
+        for child in ast.iter_child_nodes(expr):
+            _merge(out, expr_nondet(child, state, call_kinds))
+        src = _direct_source(expr)
+        keeps_order = (isinstance(expr.func, ast.Name)
+                       and expr.func.id in _ORDER_MATERIALIZERS)
+        if src is None and not keeps_order:
+            # an opaque callee consuming an ordered value usually does
+            # membership/aggregation, which is order-insensitive — only
+            # the materializers (list/tuple/...) provably carry the
+            # iteration order into their result. VALUE kinds survive
+            # any call (float(time.time()) is still the wall clock).
+            out = {k: v for k, v in out.items() if k not in ORDER_KINDS}
+        if src is not None:
+            kind, desc = src
+            if kind in ORDER_KINDS:
+                # a fresh set's order-taint replaces whatever order
+                # taint the argument carried (membership is clean)
+                out = {k: v for k, v in out.items()
+                       if k not in ORDER_KINDS}
+            out.setdefault(kind, (expr.lineno, desc))
+        if call_kinds is not None:
+            _merge(out, call_kinds(expr) or {})
+        return out
+    if isinstance(expr, ast.Compare):
+        # ==/in/>=-style comparisons read membership, not iteration
+        # order: `set(v) >= {"q", "s"}` is deterministic
+        out = {}
+        for child in ast.iter_child_nodes(expr):
+            _merge(out, expr_nondet(child, state, call_kinds))
+        return {k: v for k, v in out.items() if k not in ORDER_KINDS}
+    if isinstance(expr, ast.Set):
+        out = {}
+        for child in ast.iter_child_nodes(expr):
+            _merge(out, expr_nondet(child, state, call_kinds))
+        out = {k: v for k, v in out.items() if k not in ORDER_KINDS}
+        out.setdefault("set-order",
+                       (expr.lineno, "set display iteration order"))
+        return out
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        d = dotted(expr)
+        out = {}
+        if d:
+            for name, taint in state.items():
+                if is_name_or_prefix(d, name) \
+                        or is_name_or_prefix(name, d):
+                    _merge(out, taint)
+            return out
+        # fall through for attribute chains rooted in calls etc.
+    out = {}
+    if not isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+        for child in ast.iter_child_nodes(expr):
+            _merge(out, expr_nondet(child, state, call_kinds))
+    return out
+
+
+# ---- collective-effect vocabulary ----
+
+_LAX_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "ppermute",
+    "pshuffle", "all_gather", "all_to_all", "pgather", "all_reduce"})
+_MULTIHOST_COLLECTIVES = frozenset({
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    "host_local_array_to_global_array",
+    "global_array_to_host_local_array"})
+_DIST_INIT_NAMES = frozenset({"distributed_initialize",
+                              "maybe_initialize"})
+_CKPT_NAMED = frozenset({"save_checkpoint", "restore_checkpoint",
+                         "load_checkpoint", "release_checkpoint"})
+# attribute submit/wait on something that names itself a checkpoint
+# writer (`self._ckpt_writer.submit(...)`) — `.submit` alone is generic
+# protocol vocabulary the call graph refuses to resolve
+_WRITER_HINTS = ("ckpt", "checkpoint", "writer")
+
+# label prefixes: rules key off these (the nondeterminism rule treats
+# checkpoint-labelled effects as the "checkpointed state" sink family)
+CHECKPOINT_LABEL = "checkpoint save/restore"
+
+
+def walk_body(node: ast.AST):
+    """Walk a def body WITHOUT descending into nested function/class/
+    LAMBDA definitions — all separate frames whose bodies run at call
+    time, not where they are defined (core.walk_body is the same
+    policy minus lambdas; duplicated here so dataflow stays
+    core-independent, stricter here because summary EFFECTS must not
+    leak out of a merely-defined closure)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def checkpointer_names(fn_node: ast.AST) -> Set[str]:
+    """Names with-bound to an orbax-style checkpointer inside this
+    function (`with ocp.StandardCheckpointer() as ckptr:`) — calls on
+    them are collective checkpoint IO."""
+    out: Set[str] = set()
+    for n in walk_body(fn_node):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if (isinstance(item.context_expr, ast.Call)
+                        and call_trailing(item.context_expr).endswith(
+                            "Checkpointer")
+                        and item.optional_vars is not None):
+                    d = dotted(item.optional_vars)
+                    if d:
+                        out.add(d)
+    return out
+
+
+def collective_effect_label(call: ast.Call,
+                            ckptr_names: Set[str] = frozenset()
+                            ) -> Optional[str]:
+    """Label when `call` DIRECTLY performs a collective effect — an
+    operation every process of an SPMD cohort must execute in the same
+    order or the cohort deadlocks. None otherwise."""
+    name = call_trailing(call)
+    base = _call_base(call)
+    if name in _LAX_COLLECTIVES and (base.endswith("lax") or not base
+                                     or base.endswith("jax")):
+        return f"collective `{name}`"
+    if name in _MULTIHOST_COLLECTIVES:
+        return f"collective `{name}`"
+    if name == "shard_map":
+        return "a shard_map region (its body runs collectives)"
+    if name in _DIST_INIT_NAMES or (
+            name == "initialize" and "distributed" in base):
+        return "jax.distributed init (blocks for the cohort rendezvous)"
+    if name in _CKPT_NAMED:
+        return f"{CHECKPOINT_LABEL} (`{name}` — a collective orbax IO)"
+    if name in ("save", "restore") and base in ckptr_names:
+        return f"{CHECKPOINT_LABEL} (orbax `{name}`)"
+    if name in ("submit", "wait") and base and any(
+            h in base.lower() for h in _WRITER_HINTS):
+        return (f"{CHECKPOINT_LABEL} (async checkpoint writer "
+                f"`.{name}()` — every process must issue the same "
+                "save sequence)")
+    return None
+
+
+# ---- the per-function summary ----
+
+@dataclasses.dataclass
+class CallRecord:
+    """One resolved call site inside a function body."""
+    callee_key: tuple
+    callee_qualname: str
+    line: int
+    in_return: bool                      # the call feeds a return value
+    # (call positional index -> CALLER param index) for bare-param args
+    arg_params: Tuple[Tuple[int, int], ...] = ()
+
+
+@dataclasses.dataclass
+class Summary:
+    """What one function DOES, as visible to callers — computed
+    directly from its body, then widened one call hop at a time by the
+    `compute_summaries` fixpoint. Every effect entry maps a stable
+    label to `(line, via)`: the line is IN THIS FUNCTION (the direct
+    site or the call that inherits the effect), `via` is '' for a
+    direct site or the callee qualname the effect arrived through."""
+    key: tuple
+    qualname: str
+    path: str
+    collective: Dict[str, Tuple[int, str]] = dataclasses.field(
+        default_factory=dict)
+    nondet: Dict[str, Tuple[int, str]] = dataclasses.field(
+        default_factory=dict)
+    returns_nondet: Dict[str, Tuple[int, str]] = dataclasses.field(
+        default_factory=dict)
+    returns_process_identity: bool = False
+    escaping_params: Set[str] = dataclasses.field(default_factory=set)
+    donated_params: Dict[int, str] = dataclasses.field(
+        default_factory=dict)
+    calls: List[CallRecord] = dataclasses.field(default_factory=list)
+
+
+class _ReturnFlow(FlowVisitor):
+    """Flow pass powering a Summary's return facts: taints names
+    assigned from nondeterministic / per-host expressions, records what
+    kinds reach a `return`."""
+
+    def __init__(self):
+        self.returns: Taint = {}
+        self.returns_pid = False
+
+    def copy_state(self, state):
+        return {k: dict(v) for k, v in state.items()}
+
+    def join_states(self, a, b):
+        out = {k: dict(v) for k, v in b.items()}
+        for name, taint in a.items():
+            _merge(out.setdefault(name, {}), taint)
+        return out
+
+    def _assign(self, targets, value, state):
+        kinds = expr_nondet(value, state)
+        names = [d for t in targets for d in bound_names(t)]
+        for d in names:
+            state.pop(d, None)
+        if kinds:
+            for d in names:
+                state[d] = dict(kinds)
+        # mutation through a subscript/attribute store taints the base
+        for t in targets:
+            for base in mutated_bases(t):
+                if kinds:
+                    _merge(state.setdefault(base, {}), kinds)
+
+    def on_stmt(self, stmt, state):
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value, state)
+        elif isinstance(stmt, ast.AugAssign):
+            kinds = expr_nondet(stmt.value, state)
+            for d in bound_names(stmt.target):
+                if kinds:
+                    _merge(state.setdefault(d, {}), kinds)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            kinds = expr_nondet(stmt.value, state)
+            for kind, site in kinds.items():
+                if kind == "process-identity":
+                    self.returns_pid = True
+                else:
+                    self.returns.setdefault(kind, site)
+
+
+_ESCAPE_CALL_NAMES = frozenset({"put", "put_nowait", "submit", "send",
+                                "Thread", "append"})
+
+
+def _direct_summary(fn, graph) -> Summary:
+    node = fn.node
+    s = Summary(key=fn.key, qualname=fn.qualname, path=fn.ctx.rel)
+    args = node.args
+    params = [a.arg for a in
+              list(getattr(args, "posonlyargs", ())) + list(args.args)]
+    param_index = {p: i for i, p in enumerate(params)}
+    param_set = set(params) - {"self", "cls"}
+    donors = _file_donors(fn.ctx)
+    ckptrs = checkpointer_names(node)
+
+    return_call_ids = set()
+    for n in walk_body(node):
+        if isinstance(n, ast.Return) and n.value is not None:
+            for c in ast.walk(n.value):
+                if isinstance(c, ast.Call):
+                    return_call_ids.add(id(c))
+
+    for n in walk_body(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            # closure capture of a param is an escape
+            for read, _rn in reads(n):
+                root = read.split(".", 1)[0]
+                if root in param_set:
+                    s.escaping_params.add(root)
+            continue
+        if isinstance(n, ast.Assign):
+            # storing a param into an attribute/global publishes it
+            for t in n.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    for read, _rn in reads(n.value):
+                        root = read.split(".", 1)[0]
+                        if root in param_set:
+                            s.escaping_params.add(root)
+        if not isinstance(n, ast.Call):
+            continue
+        label = collective_effect_label(n, ckptrs)
+        if label is not None:
+            s.collective.setdefault(label, (n.lineno, ""))
+        src = _direct_source(n)
+        if src is not None and src[0] != "process-identity":
+            s.nondet.setdefault(src[0], (n.lineno, src[1]))
+        if call_trailing(n) in _ESCAPE_CALL_NAMES:
+            for a in n.args:
+                d = dotted(a)
+                if d and d.split(".", 1)[0] in param_set:
+                    s.escaping_params.add(d.split(".", 1)[0])
+        # donation of a param through a file-local donating callable
+        spec = _donor_spec_for_call(n, fn, donors)
+        if spec is not None and not fn.cls:
+            argnums, argnames = spec
+            for i, a in enumerate(n.args):
+                d = dotted(a)
+                if i in argnums and d in param_index:
+                    s.donated_params[param_index[d]] = d
+            for kw in n.keywords:
+                d = dotted(kw.value)
+                if kw.arg in argnames and d in param_index:
+                    s.donated_params[param_index[d]] = d
+        # resolved call record for the fixpoint
+        target = graph.resolve_call(fn, n)
+        if target is not None:
+            arg_params = []
+            shift = 1 if (target.cls
+                          and isinstance(n.func, ast.Attribute)) else 0
+            for i, a in enumerate(n.args):
+                d = dotted(a)
+                if d in param_index:
+                    arg_params.append((i + shift, param_index[d]))
+            s.calls.append(CallRecord(
+                callee_key=target.key, callee_qualname=target.qualname,
+                line=n.lineno, in_return=id(n) in return_call_ids,
+                arg_params=tuple(arg_params)))
+
+    flow = _ReturnFlow()
+    run_flow(node, flow)
+    s.returns_nondet = flow.returns
+    if flow.returns_pid:
+        s.returns_process_identity = True
+    return s
+
+
+def _file_donors(ctx) -> FileDonors:
+    """One FileDonors per FileContext, cached on the context itself
+    (no global table to leak across runs)."""
+    d = getattr(ctx, "_graftlint_donors", None)
+    if d is None:
+        d = FileDonors(ctx.tree)
+        ctx._graftlint_donors = d
+    return d
+
+
+def _donor_spec_for_call(call: ast.Call, fn, donors: FileDonors
+                         ) -> Optional[Spec]:
+    d = dotted(call.func)
+    if d:
+        if d in donors.defs:
+            return donors.defs[d]
+        if d in donors.module_names:
+            return donors.module_names[d]
+        if fn.cls and (fn.cls, d) in donors.class_attrs:
+            return donors.class_attrs[(fn.cls, d)]
+    if isinstance(call.func, ast.Call):
+        return jit_donate_spec(call.func)
+    return None
+
+
+def compute_summaries(scan) -> Dict[tuple, Summary]:
+    """Two passes (the module docstring has the contract): direct
+    per-function facts, then a worklist fixpoint widening each fact
+    one resolved call hop at a time until nothing changes. Monotone —
+    labels/kinds only ever get ADDED — so recursion and call cycles
+    terminate instead of looping."""
+    fns = scan.functions
+    graph = scan.graph
+    summaries = {fn.key: _direct_summary(fn, graph) for fn in fns}
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            s = summaries[fn.key]
+            for rec in s.calls:
+                cs = summaries.get(rec.callee_key)
+                if cs is None or cs is s:
+                    continue
+                for label in cs.collective:
+                    if label not in s.collective:
+                        s.collective[label] = (rec.line,
+                                               rec.callee_qualname)
+                        changed = True
+                for kind, site in cs.nondet.items():
+                    if kind not in s.nondet:
+                        s.nondet[kind] = (rec.line, rec.callee_qualname)
+                        changed = True
+                if rec.in_return:
+                    for kind in cs.returns_nondet:
+                        if kind not in s.returns_nondet:
+                            s.returns_nondet[kind] = (
+                                rec.line, rec.callee_qualname)
+                            changed = True
+                    if cs.returns_process_identity \
+                            and not s.returns_process_identity:
+                        s.returns_process_identity = True
+                        changed = True
+                if not fn.cls and cs.donated_params:
+                    for pos, pidx in rec.arg_params:
+                        if pos in cs.donated_params \
+                                and pidx not in s.donated_params:
+                            # the callee donates the buffer our param
+                            # aliases — our caller loses it too
+                            name = None
+                            fargs = fn.node.args
+                            plist = [a.arg for a in
+                                     list(getattr(fargs, "posonlyargs",
+                                                  ())) + list(fargs.args)]
+                            if pidx < len(plist):
+                                name = plist[pidx]
+                            if name:
+                                s.donated_params[pidx] = name
+                                changed = True
+    return summaries

@@ -15,3 +15,7 @@ from tools.graftlint.rules import (config_drift, host_sync,  # noqa: F401
 # the dataflow rules (ISSUE 12) — built on tools/graftlint/dataflow.py
 from tools.graftlint.rules import (donation_safety,  # noqa: F401
                                    resource_leak, thread_handoff)
+# the interprocedural rules (ISSUE 14) — built on the call-summary
+# layer (dataflow.compute_summaries over core.Scan)
+from tools.graftlint.rules import (nondeterminism,  # noqa: F401
+                                   spmd_divergence)

@@ -39,6 +39,15 @@ copy: `jnp.copy`, `copy.deepcopy`, `.copy()`, `jax.device_get`) holds
 fresh buffers — it never inherits taint through the alias edge, which
 is precisely what makes the snapshot-then-step checkpoint idiom clean.
 
+Interprocedural hop (ISSUE 14): a call to a function whose SUMMARY
+says its body donates a param (`Summary.donated_params` — a wrapper
+like `def run_step(params, opt, b, r): return step(params, opt, b,
+r)`) taints the caller's argument exactly like a direct donating call
+would: the wrapper's callee deleted the buffers either way. The donor
+vocabulary (jit_donate_spec, FileDonors, the factory table) moved to
+tools/graftlint/dataflow.py so the summary pass shares one
+definition of "donating callable" with this rule.
+
 Under-reach (dataflow.py has the policy): donation only taints plain
 dotted-name arguments; unresolvable callees donate nothing; one
 finding per donated name per function (the first read).
@@ -47,142 +56,19 @@ finding per donated name per function (the first read).
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 from tools.graftlint import dataflow as df
-from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
-                                  register)
+from tools.graftlint.core import (FileContext, Finding, FnInfo, Rule,
+                                  Scan, call_name, register)
+from tools.graftlint.dataflow import (FileDonors as _FileDonors,
+                                      Spec, donating_value_spec
+                                      as _donating_value_spec,
+                                      jit_donate_spec)
 
 RULE = "donation-safety"
 
-# the repo's step-factory seams: calling the RESULT donates these
-# positional args (training/steps.py, training/sparse_steps.py,
-# training/vm_steps.py all funnel through one make_* entry each)
-_FACTORIES: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
-    "make_train_step": ((0, 1), ()),
-    "make_sparse_train_step": ((0, 1), ()),
-    "make_vm_train_step": ((0, 1), ()),
-}
-
-# assigning from these produces FRESH buffers — immune to alias taint
-_SNAPSHOT_CALLS = frozenset({"snapshot_state", "copy", "deepcopy",
-                             "device_get", "asarray", "array"})
-
-_JIT_NAMES = frozenset({"jit", "pjit"})
-
-Spec = Tuple[Tuple[int, ...], Tuple[str, ...]]  # (argnums, argnames)
-
-
-def _literal_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, int):
-        return (node.value,)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        out = []
-        for e in node.elts:
-            if not (isinstance(e, ast.Constant)
-                    and isinstance(e.value, int)):
-                return None
-            out.append(e.value)
-        return tuple(out)
-    return None
-
-
-def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return (node.value,)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        out = []
-        for e in node.elts:
-            if not (isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)):
-                return None
-            out.append(e.value)
-        return tuple(out)
-    return None
-
-
-def jit_donate_spec(call: ast.Call) -> Optional[Spec]:
-    """The donation spec of a `jit(..., donate_argnums=...)` /
-    `functools.partial(jax.jit, donate_argnums=...)` call, or None."""
-    name = call_name(call)
-    if name == "partial":
-        if not (call.args and call_name_of(call.args[0]) in _JIT_NAMES):
-            return None
-    elif name not in _JIT_NAMES:
-        return None
-    argnums: Tuple[int, ...] = ()
-    argnames: Tuple[str, ...] = ()
-    for kw in call.keywords:
-        if kw.arg == "donate_argnums":
-            argnums = _literal_ints(kw.value) or ()
-        elif kw.arg == "donate_argnames":
-            argnames = _literal_strs(kw.value) or ()
-    if argnums or argnames:
-        return (argnums, argnames)
-    return None
-
-
-def call_name_of(node: ast.AST) -> str:
-    """Trailing name of a Name/Attribute (non-call) expression."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return ""
-
-
-def _donating_value_spec(value: ast.AST) -> Optional[Spec]:
-    """Spec when `value` evaluates to a donating callable: a
-    jit-with-donate call or a step-factory call."""
-    if not isinstance(value, ast.Call):
-        return None
-    spec = jit_donate_spec(value)
-    if spec is not None:
-        return spec
-    if isinstance(value.func, ast.Call):
-        # functools.partial(jax.jit, donate_argnums=...)(f)
-        spec = jit_donate_spec(value.func)
-        if spec is not None:
-            return spec
-    return _FACTORIES.get(call_name(value))
-
-
-class _FileDonors:
-    """File-level donor tables built in one pre-pass: decorated defs,
-    module-scope donor names, and per-class `self.X` donor attrs."""
-
-    def __init__(self, ctx: FileContext):
-        self.defs: Dict[str, Spec] = {}
-        self.module_names: Dict[str, Spec] = {}
-        self.class_attrs: Dict[Tuple[str, str], Spec] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if isinstance(dec, ast.Call):
-                        spec = jit_donate_spec(dec)
-                        if spec is not None:
-                            self.defs[node.name] = spec
-            elif isinstance(node, ast.ClassDef):
-                for n in ast.walk(node):
-                    if not (isinstance(n, ast.Assign)
-                            and isinstance(n.value, ast.Call)):
-                        continue
-                    spec = _donating_value_spec(n.value)
-                    if spec is None:
-                        continue
-                    for t in n.targets:
-                        d = df.dotted(t)
-                        if d.startswith("self."):
-                            self.class_attrs[(node.name, d)] = spec
-        for stmt in ctx.tree.body:
-            if isinstance(stmt, ast.Assign) \
-                    and isinstance(stmt.value, ast.Call):
-                spec = _donating_value_spec(stmt.value)
-                if spec is not None:
-                    for t in stmt.targets:
-                        d = df.dotted(t)
-                        if d:
-                            self.module_names[d] = spec
+_SNAPSHOT_CALLS = df.SNAPSHOT_CALLS
 
 
 # state facts (per dotted name):
@@ -194,12 +80,16 @@ class _FileDonors:
 
 class _Flow(df.FlowVisitor):
     def __init__(self, ctx: FileContext, fn: ast.AST, cls: str,
-                 donors: _FileDonors, findings: List[Finding]):
+                 donors: _FileDonors, findings: List[Finding],
+                 fn_info: Optional[FnInfo] = None,
+                 scan: Optional[Scan] = None):
         self.ctx = ctx
         self.fn = fn
         self.cls = cls
         self.donors = donors
         self.findings = findings
+        self.fn_info = fn_info
+        self.scan = scan
         self.qualname = f"{cls}.{fn.name}" if cls else fn.name
         # one finding per (name, donation site) — the loop fixpoint
         # pass must not double-report
@@ -255,11 +145,27 @@ class _Flow(df.FlowVisitor):
                 if mfact is None or mfact[0] not in ("snap", "donated"):
                     state[m] = ("donated", callee, line, True)
 
+    def _summary_spec(self, node: ast.Call) -> Optional[Spec]:
+        """The ISSUE 14 hop: the callee's SUMMARY says its body donates
+        some of its params (a wrapper around a donating step) — the
+        caller's buffers are gone just the same."""
+        if self.scan is None or self.fn_info is None:
+            return None
+        target = self.scan.graph.resolve_call(self.fn_info, node)
+        if target is None or target.cls:
+            return None  # method position shifts: under-reach
+        summ = self.scan.summaries.get(target.key)
+        if summ is None or not summ.donated_params:
+            return None
+        return (tuple(sorted(summ.donated_params)), ())
+
     def _apply_calls(self, stmt: ast.AST, state) -> None:
         for node in ast.walk(stmt):
             if not isinstance(node, ast.Call):
                 continue
             spec = self._callee_spec(node.func, state)
+            if spec is None:
+                spec = self._summary_spec(node)
             if spec is None:
                 continue
             callee = df.dotted(node.func) or call_name(node) or "jit"
@@ -411,12 +317,18 @@ class DonationSafetyRule(Rule):
     name = RULE
     description = ("a name read/returned/captured after being passed "
                    "to a donating call (jit donate_argnums, the "
-                   "make_train_step seams) — reassignment kills the "
+                   "make_train_step seams, or a callee whose summary "
+                   "donates its params) — reassignment kills the "
                    "taint, snapshot_state results are sanctioned")
 
-    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+    def check_scan(self, scan: Scan) -> Iterable[Finding]:
         findings: List[Finding] = []
-        donors = _FileDonors(ctx)
-        for fn, cls in df.iter_functions(ctx.tree):
-            df.run_flow(fn, _Flow(ctx, fn, cls, donors, findings))
+        for fn_info in scan.functions:
+            ctx = fn_info.ctx
+            # the summary pass caches FileDonors on the context —
+            # reuse it instead of paying the donor pre-pass twice
+            donors = df._file_donors(ctx)
+            df.run_flow(fn_info.node,
+                        _Flow(ctx, fn_info.node, fn_info.cls, donors,
+                              findings, fn_info=fn_info, scan=scan))
         return findings

@@ -40,17 +40,19 @@ simple names resolve within the module then to a globally-unique def;
 `self.x(...)` resolves within the class; other attribute calls resolve
 only when the method name is defined exactly once repo-wide and is not
 a common container-protocol name. Unresolvable calls end traversal —
-the rule under-reaches rather than spraying false paths.
+the rule under-reaches rather than spraying false paths. The function
+index and resolver live in core (`Scan.functions` / `Scan.graph`,
+ISSUE 14) so the summary layer and the SPMD rules share them; this
+rule keeps only its roots, sanctions and violation vocabulary.
 """
 
 from __future__ import annotations
 
 import ast
-import dataclasses
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Tuple
 
-from tools.graftlint.core import (FileContext, Finding, Rule, call_name,
-                                  is_self_attr, register, walk_body)
+from tools.graftlint.core import (Finding, FnInfo, Rule, Scan,
+                                  call_name, register, walk_body)
 
 RULE = "host-sync-in-hot-path"
 
@@ -68,38 +70,9 @@ _ROOT_METHODS = frozenset({
 _SANCTIONED = frozenset({("", "device_sync"), ("_Span", "stop"),
                          ("", "fetch_global")})
 
-# attribute-call names too generic to resolve by global uniqueness
-# (container/protocol vocabulary — resolving `.get()` to some class's
-# `get` would build fantasy edges)
-_GENERIC_ATTRS = frozenset({
-    "get", "put", "items", "keys", "values", "append", "add", "update",
-    "pop", "close", "open", "read", "write", "run", "start", "stop",
-    "join", "split", "copy", "clear", "count", "index", "sort", "submit",
-})
-
 # numpy module aliases whose `.asarray` is a device->host fetch when fed
 # a jax array (jnp.asarray is host->device and is NOT flagged)
 _NP_ALIASES = frozenset({"np", "numpy", "onp"})
-
-
-@dataclasses.dataclass
-class _Fn:
-    """One function definition in the scan set."""
-    ctx: FileContext
-    node: ast.AST           # FunctionDef / AsyncFunctionDef
-    cls: str                # enclosing class name ('' at module level)
-
-    @property
-    def name(self) -> str:
-        return self.node.name
-
-    @property
-    def qualname(self) -> str:
-        return f"{self.cls}.{self.name}" if self.cls else self.name
-
-    @property
-    def key(self) -> Tuple[str, str, str]:
-        return (self.ctx.rel, self.cls, self.name)
 
 
 def _has_jit_decorator(node: ast.AST) -> bool:
@@ -130,85 +103,12 @@ def _mentions_shape_math(node: ast.AST) -> bool:
     return all_const
 
 
-def _index_functions(ctxs: Sequence[FileContext]) -> List[_Fn]:
-    fns: List[_Fn] = []
-    for ctx in ctxs:
-        stack: List[Tuple[ast.AST, str]] = [(ctx.tree, "")]
-        while stack:
-            node, cls = stack.pop()
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, ast.ClassDef):
-                    stack.append((child, child.name))
-                elif isinstance(child, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef)):
-                    fns.append(_Fn(ctx, child, cls))
-                    # nested defs (jitted inner steps) are functions too
-                    stack.append((child, cls))
-                elif isinstance(child, (ast.If, ast.Try, ast.With,
-                                        ast.For, ast.AsyncFor,
-                                        ast.While, ast.ExceptHandler)):
-                    # defs also hide in loop bodies and except-import
-                    # fallbacks — they must be indexable as hot roots
-                    stack.append((child, cls))
-    return fns
-
-
-class _Graph:
-    """Name-heuristic call graph over the indexed functions."""
-
-    def __init__(self, fns: List[_Fn]):
-        self.fns = fns
-        self.by_key = {f.key: f for f in fns}
-        self.by_name: Dict[str, List[_Fn]] = {}
-        for f in fns:
-            self.by_name.setdefault(f.name, []).append(f)
-        # per (file, class): method name -> fn
-        self.methods: Dict[Tuple[str, str], Dict[str, _Fn]] = {}
-        # per file: module-scope function name -> fn
-        self.module_fns: Dict[str, Dict[str, _Fn]] = {}
-        for f in fns:
-            if f.cls:
-                self.methods.setdefault(
-                    (f.ctx.rel, f.cls), {})[f.name] = f
-            else:
-                self.module_fns.setdefault(f.ctx.rel, {})[f.name] = f
-
-    def _unique(self, name: str) -> Optional[_Fn]:
-        hits = self.by_name.get(name, ())
-        return hits[0] if len(hits) == 1 else None
-
-    def resolve_call(self, fn: _Fn, call: ast.Call) -> Optional[_Fn]:
-        func = call.func
-        if isinstance(func, ast.Name):
-            local = self.module_fns.get(fn.ctx.rel, {}).get(func.id)
-            if local is not None:
-                return local
-            return self._unique(func.id)  # imported def elsewhere
-        if isinstance(func, ast.Attribute):
-            attr = func.attr
-            if is_self_attr(func) is not None and fn.cls:
-                mine = self.methods.get((fn.ctx.rel, fn.cls), {}).get(attr)
-                if mine is not None:
-                    return mine
-            if attr in _GENERIC_ATTRS:
-                return None
-            return self._unique(attr)
-        return None
-
-    def callees(self, fn: _Fn) -> Iterable[_Fn]:
-        for node in walk_body(fn.node):
-            if isinstance(node, ast.Call):
-                target = self.resolve_call(fn, node)
-                if target is not None:
-                    yield target
-
-
-def _is_sanctioned(fn: _Fn) -> bool:
+def _is_sanctioned(fn: FnInfo) -> bool:
     return ((fn.cls, fn.name) in _SANCTIONED
             or ("", fn.name) in _SANCTIONED)
 
 
-def _scan_violations(fn: _Fn, root_label: str) -> Iterable[Finding]:
+def _scan_violations(fn: FnInfo, root_label: str) -> Iterable[Finding]:
     # which root reached us is BFS-order-dependent context -> `detail`
     # (outside the baseline identity), never part of the message
     via = f"hot path via {root_label}" if root_label != fn.qualname \
@@ -257,18 +157,18 @@ class HostSyncRule(Rule):
                    "reachable from the jitted step / predict / "
                    "batcher-flush paths")
 
-    def check_repo(self, ctxs: Sequence[FileContext],
-                   root: str) -> Iterable[Finding]:
-        fns = _index_functions(ctxs)
-        graph = _Graph(fns)
+    def check_scan(self, scan: Scan) -> Iterable[Finding]:
+        fns = scan.functions
+        graph = scan.graph
         roots = [f for f in fns
                  if (_has_jit_decorator(f.node)
                      or (f.cls, f.name) in _ROOT_METHODS)
                  and not _is_sanctioned(f)]
         # BFS; remember which root first reached each function so the
-        # message can say WHY it is considered hot
-        reached: Dict[Tuple[str, str, str], str] = {}
-        queue: List[Tuple[_Fn, str]] = [(f, f.qualname) for f in roots]
+        # message can say WHY it is considered hot (keys are
+        # FnInfo.key 4-tuples: rel, cls, scope, name)
+        reached: Dict[tuple, str] = {}
+        queue: List[Tuple[FnInfo, str]] = [(f, f.qualname) for f in roots]
         for f, label in queue:
             reached.setdefault(f.key, label)
         i = 0

@@ -1,0 +1,259 @@
+"""nondeterminism: a nondeterministic value flowing into the
+resume-parity surface.
+
+PR 10/12's parity bars promise that resume is bit-exact and
+topology-independent: a SIGKILLed-and-resumed run must equal an
+uninterrupted one, at any cohort size. That only holds while
+everything feeding the numerics is a function of (seed, step, data)
+alone — the moment wall clock, the unseeded global `random`/
+`np.random` streams, unsorted `os.listdir`/`glob` results, set
+iteration order, or `id()`/`hash()` (PYTHONHASHSEED differs per
+process) leaks into a tensor, an rng seam or checkpointed state, the
+parity tests turn flaky in ways no single run can see.
+
+Mechanics: the shared flow engine taints names assigned from
+nondeterministic sources (dataflow.expr_nondet — ORDER kinds like
+fs-order die at `sorted()`/`len()`-style order-insensitive consumers,
+VALUE kinds like wall-clock survive any transform; reassignment
+kills), plus the INTERPROCEDURAL hop: a call to a function whose
+summary says it RETURNS nondeterminism (`compute_summaries`) is a
+source too. A finding fires only when a tainted value reaches a sink:
+
+  - tensor construction (`jnp.*`, `np.array/asarray/full`,
+    `device_put`);
+  - an rng/shuffle seam (`PRNGKey`/`key`/`fold_in`, `random.seed`,
+    `np.random.seed`, any call's `seed=` keyword);
+  - checkpointed state (`save_checkpoint` & friends, the async
+    writer's `.submit`, any call whose summary carries a
+    checkpoint-labelled collective effect — the one-hop sink).
+
+Sanctioned seams (ISSUE 14): the step-keyed rng idiom
+(`fold_in(rng, step)`) and the seeded retry jitter are clean BY
+CONSTRUCTION — their inputs are never tainted (instance streams like
+`self._rng.random()` are deliberately not sources; only the module-
+global streams are). Telemetry timestamps never flag because
+telemetry/event emission is not a sink — timestamps belong in event
+logs, just not in tensors. `dither_from_index` is sanctioned BY NAME:
+it is the deterministic counter-hash dither (ops/quant.py), and calls
+to it are neither sources nor sinks regardless of what its bit-mixing
+body looks like to the summary pass.
+
+Per-host process-identity values are EXCLUDED here — `np.full(B,
+process_index())` is the multihost row-tagging mechanism, not a bug;
+divergence hazards are spmd-divergence's jurisdiction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftlint import dataflow as df
+from tools.graftlint.core import (FileContext, Finding, FnInfo, Rule,
+                                  Scan, register)
+
+RULE = "nondeterminism"
+
+# kinds this rule reports (process-identity is spmd-divergence's)
+_REPORTED = frozenset({"wall-clock", "global-rng", "fs-order",
+                       "set-order", "object-identity"})
+
+# calls that are neither sources nor sinks, whatever their bodies look
+# like: the audited deterministic seams
+_SANCTIONED_CALLS = frozenset({"dither_from_index"})
+
+_RNG_SINKS = frozenset({"PRNGKey", "key", "fold_in"})
+_SEED_KWARGS = frozenset({"seed", "rng_seed"})
+_TENSOR_FNS = frozenset({"array", "asarray", "full", "device_put",
+                         "full_like"})
+_NP_ALIASES = frozenset({"np", "numpy", "onp", "jnp"})
+
+
+class _Flow(df.FlowVisitor):
+    def __init__(self, fn: FnInfo, scan: Scan, findings: List[Finding]):
+        self.fn = fn
+        self.ctx: FileContext = fn.ctx
+        self.scan = scan
+        self.findings = findings
+        self.ckptrs = df.checkpointer_names(fn.node)
+        self.flagged = set()  # (line, sink, kind)
+
+    # --- state: name -> {kind: (line, desc)} ---
+
+    def copy_state(self, state):
+        return {k: dict(v) for k, v in state.items()}
+
+    def join_states(self, a, b):
+        out = {k: dict(v) for k, v in b.items()}
+        for name, taint in a.items():
+            df._merge(out.setdefault(name, {}), taint)
+        return out
+
+    # --- the interprocedural source hook ---
+
+    def _call_kinds(self, call: ast.Call) -> df.Taint:
+        name = df.call_trailing(call)
+        if name in _SANCTIONED_CALLS:
+            return {}
+        target = self.scan.graph.resolve_call(self.fn, call)
+        if target is None:
+            return {}
+        summ = self.scan.summaries.get(target.key)
+        if summ is None:
+            return {}
+        return {kind: (call.lineno, f"returned by `{target.qualname}`")
+                for kind in summ.returns_nondet if kind in _REPORTED}
+
+    def _taint(self, expr: Optional[ast.AST], state) -> df.Taint:
+        kinds = df.expr_nondet(expr, state, self._call_kinds)
+        return {k: v for k, v in kinds.items() if k in _REPORTED}
+
+    # --- sinks ---
+
+    def _sink_label(self, call: ast.Call) -> Optional[str]:
+        name = df.call_trailing(call)
+        if name in _SANCTIONED_CALLS:
+            return None
+        base = df._call_base(call)
+        base_root = base.split(".", 1)[0] if base else ""
+        if base_root == "jnp" or base.startswith("jax.numpy"):
+            return f"tensor construction (`{base}.{name}`)"
+        if name in _TENSOR_FNS and (base_root in _NP_ALIASES
+                                    or base_root == "jax"):
+            return f"tensor construction (`{base}.{name}`)"
+        if name in _RNG_SINKS:
+            return f"the rng seam `{name}(...)`"
+        if name == "seed" and (base == "random"
+                               or base in df._NP_RANDOM_BASES):
+            return f"the global rng seed (`{base}.seed`)"
+        label = df.collective_effect_label(call, self.ckptrs)
+        if label is not None and df.CHECKPOINT_LABEL in label:
+            return "checkpointed state (the resume-parity surface)"
+        target = self.scan.graph.resolve_call(self.fn, call)
+        if target is not None:
+            summ = self.scan.summaries.get(target.key)
+            if summ is not None and any(
+                    df.CHECKPOINT_LABEL in lbl
+                    for lbl in summ.collective):
+                return ("checkpointed state (the resume-parity "
+                        f"surface, via `{target.qualname}`)")
+        return None
+
+    def _check_sinks(self, node: Optional[ast.AST], state) -> None:
+        if node is None:
+            return
+        # pruned walk: a sink call inside a nested def/lambda executes
+        # in its own frame at call time, not at the definition site
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if not isinstance(n, ast.Call):
+                continue
+            sink = self._sink_label(n)
+            if sink is not None:
+                for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                    self._report(n, sink, self._taint(arg, state))
+            for kw in n.keywords:
+                if kw.arg in _SEED_KWARGS:
+                    self._report(
+                        n, f"the `{kw.arg}=` seam of `"
+                           f"{df.call_trailing(n)}(...)`",
+                        self._taint(kw.value, state))
+
+    def _report(self, call: ast.Call, sink: str, kinds: df.Taint) -> None:
+        for kind, (line, desc) in sorted(kinds.items()):
+            key = (call.lineno, sink, kind)
+            if key in self.flagged:
+                continue
+            self.flagged.add(key)
+            self.findings.append(Finding(
+                rule=RULE, path=self.ctx.rel, line=call.lineno,
+                symbol=self.fn.qualname,
+                detail=f"source: {desc} at line {line}",
+                message=(f"{df.KIND_DESC[kind]} flows into {sink} — "
+                         "the resume-parity bar (bit-exact, topology-"
+                         "independent restarts) only holds for values "
+                         "derived from (seed, step, data); thread the "
+                         "seeded stream / sort the listing / key by "
+                         "step instead")))
+
+    # --- engine hooks ---
+
+    def on_expr(self, expr, state):
+        self._check_sinks(expr, state)
+        # the engine evaluates a `for` iterable immediately before
+        # binding its targets — remember it so on_bind can hand the
+        # iterable's taint to the loop variable (`for n in
+        # os.listdir(d):` makes `n` order-dependent)
+        self._last_control_expr = expr
+
+    def on_bind(self, target, state, source, value=None):
+        kinds = {}
+        if source == "for":
+            kinds = self._taint(getattr(self, "_last_control_expr",
+                                        None), state)
+        elif source == "with" and value is not None:
+            kinds = self._taint(value, state)
+        for name in df.bound_names(target):
+            state.pop(name, None)
+            if kinds:
+                state[name] = dict(kinds)
+
+    def on_stmt(self, stmt, state):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            self._check_sinks(value, state)
+            kinds = self._taint(value, state) if value is not None else {}
+            for t in targets:
+                for d in df.bound_names(t):
+                    state.pop(d, None)
+                    if kinds:
+                        state[d] = dict(kinds)
+                for base in df.mutated_bases(t):
+                    if kinds:
+                        df._merge(state.setdefault(base, {}), kinds)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_sinks(stmt.value, state)
+            kinds = self._taint(stmt.value, state)
+            for d in df.bound_names(stmt.target):
+                if kinds:
+                    df._merge(state.setdefault(d, {}), kinds)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                d = df.dotted(t)
+                if d:
+                    state.pop(d, None)
+            return
+        self._check_sinks(stmt, state)
+        # `x.sort()` sorts in place: the name's ORDER taint dies here
+        if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "sort"):
+            d = df.dotted(stmt.value.func.value)
+            if d and d in state:
+                state[d] = {k: v for k, v in state[d].items()
+                            if k not in df.ORDER_KINDS}
+
+
+@register
+class NondeterminismRule(Rule):
+    name = RULE
+    description = ("wall clock / global-rng / fs-order / set-order / "
+                   "id()-hash() values flowing into tensor "
+                   "construction, rng seams or checkpointed state "
+                   "(summary-aware: sources and checkpoint sinks "
+                   "resolve one call hop deep)")
+
+    def check_scan(self, scan: Scan) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in scan.functions:
+            df.run_flow(fn.node, _Flow(fn, scan, findings))
+        return findings

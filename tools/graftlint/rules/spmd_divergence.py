@@ -1,0 +1,350 @@
+"""spmd-divergence: a collective effect reachable on only SOME
+processes of the cohort.
+
+The stack's hardest-won invariant (PR 5's one-in-flight async writer,
+PR 9's Gloo cohorts, PR 12's elastic re-form) is that every process
+executes the same collective/checkpoint-submit sequence in the same
+order — one process skipping (or repeating) a collective deadlocks the
+rest inside the rendezvous, a failure mode only the slow multiprocess
+chaos tests could see until now. This rule catches the static shape of
+that bug: a collective-effect call (dataflow.collective_effect_label —
+lax collectives, shard_map regions, jax.distributed init, orbax
+checkpoint save/restore, the async writer's submit/wait, or ANY call
+whose summary inherits one of those) sitting under PROCESS-DIVERGENT
+control:
+
+  - a branch whose test reads per-host identity — `process_index()`,
+    `host_id()`, `local_devices()`/`local_device_count()`,
+    `getpid()`/`gethostname()`, a name assigned from one of those, or
+    a call to a function whose SUMMARY says it returns a per-host
+    value (`faults._process_index`, `compat.cohort_world` — the
+    interprocedural hop);
+  - the remainder of a block after a process-divergent early exit
+    (`if process_index(): return` poisons everything below);
+  - an `except` handler body — only the processes that raised take it,
+    which is exactly the distributed-deadlock retry class (one process
+    re-issuing a collective alone);
+  - a loop whose trip condition / iterable is per-host.
+
+Branches on `process_count()` / `device_count()` are NOT divergent —
+those are cohort-uniform — and neither is per-host data flowing into
+tensors (that is the multihost tagging mechanism, jax_model's
+`_my_global_rows`).
+
+Sanctioned seams (the audited exceptions, by (qualname, path suffix)):
+`distributed_initialize` / `maybe_initialize._init`'s retry — the ONE
+place a failed collective is deliberately re-issued, because a failed
+INIT left no cohort to desynchronize from (each attempt resets the
+distributed state first; the module docstring owns the policy) — and
+the process-0 sidecar writers `write_step_checksums` /
+`write_step_topology` plus their caller seam in `save_checkpoint`:
+pure file IO that runs AFTER the commit rename, so by the time process
+0 diverges to write `checksums.json`/`topology.json` every process has
+already completed the same collective save (ARCHITECTURE.md
+"Summaries: one hop deeper, still never import" has the full
+argument). Sanctioned bodies are skipped; CALLS to sanctioned
+functions still flag when they sit under divergent control elsewhere —
+the audit covers their bodies, not their callers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftlint import dataflow as df
+from tools.graftlint.core import (Finding, FnInfo, Rule, Scan, register)
+
+RULE = "spmd-divergence"
+
+# (qualname, path-suffix) of the audited seams (module docstring)
+_SANCTIONED = frozenset({
+    ("distributed_initialize", "parallel/compat.py"),
+    ("maybe_initialize", "parallel/distributed.py"),
+    ("_init", "parallel/distributed.py"),
+    ("save_checkpoint", "training/checkpoint.py"),
+    ("write_step_checksums", "training/checkpoint.py"),
+    ("write_step_topology", "training/checkpoint.py"),
+})
+
+
+def _is_sanctioned(fn: FnInfo) -> bool:
+    return any(fn.qualname == q and fn.ctx.rel.endswith(suffix)
+               for q, suffix in _SANCTIONED)
+
+
+# exception types that depend only on the CODE, not the environment:
+# every process of a homogeneous cohort (same interpreter, same wheel)
+# raises them identically, so a handler catching ONLY these is
+# cohort-uniform — the compat version probes (`except TypeError:`
+# around the shard_map kwarg rename) are the canonical shape. IO /
+# runtime errors stay divergent: only the host whose disk hiccuped
+# takes that handler.
+_UNIFORM_EXCEPTIONS = frozenset({
+    "TypeError", "AttributeError", "ImportError", "ModuleNotFoundError",
+    "NameError", "NotImplementedError", "SyntaxError"})
+
+
+def _uniform_handler(handler: ast.ExceptHandler) -> bool:
+    """True when the handler catches ONLY code-uniform exception
+    types (see _UNIFORM_EXCEPTIONS)."""
+    t = handler.type
+    if t is None:
+        return False  # bare except: catches env-dependent errors too
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for e in types:
+        if isinstance(e, ast.Name):
+            names.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.append(e.attr)
+        else:
+            return False
+    return bool(names) and all(n in _UNIFORM_EXCEPTIONS for n in names)
+
+
+def _walk_pruned(node: ast.AST):
+    """Walk an expression/statement tree WITHOUT entering nested
+    function/class/lambda bodies: those run in their own frame at CALL
+    time — merely DEFINING a lambda holding a collective under a
+    divergent branch executes nothing (review round: `fn = lambda v:
+    psum(v, ...)` under a rank branch must not flag; calling it does,
+    wherever that happens)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    """The block always leaves the enclosing block (direct last-
+    statement check — under-reach on nested shapes)."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _FnScan:
+    """One function's divergence walk: tracks per-host-tainted names in
+    program order, carries the active divergence reason down into
+    branch arms / handler bodies, flags collective effects inside
+    divergent regions."""
+
+    def __init__(self, fn: FnInfo, scan: Scan, findings: List[Finding]):
+        self.fn = fn
+        self.scan = scan
+        self.findings = findings
+        self.ckptrs = df.checkpointer_names(fn.node)
+        self.flagged = set()  # (line, label) — no duplicate reports
+
+    # --- per-host taint + divergence tests ---
+
+    def _call_reason(self, call: ast.Call) -> Optional[str]:
+        src = df._direct_source(call)
+        if src is not None and src[0] == "process-identity":
+            return f"`{src[1]}`"
+        target = self.scan.graph.resolve_call(self.fn, call)
+        if target is not None and not _is_sanctioned(target):
+            summ = self.scan.summaries.get(target.key)
+            if summ is not None and summ.returns_process_identity:
+                return (f"`{target.qualname}()` (returns a per-host "
+                        "value)")
+        return None
+
+    def _expr_reason(self, expr: Optional[ast.AST],
+                     state: Dict[str, str]) -> Optional[str]:
+        """Why evaluating `expr` can differ across processes, or None.
+        Calls are OPAQUE taint barriers: `open_reader(host_shard=
+        process_index())` yields a reader whose batch count is aligned
+        across hosts by an audited contract — the analysis cannot
+        prove divergence through a call result, so it drops the fact
+        (the under-reach policy). A call counts only when IT returns
+        per-host identity (directly or per its summary)."""
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Call):
+            return self._call_reason(expr)
+        if isinstance(expr, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(expr, "ctx", None), ast.Load):
+            d = df.dotted(expr)
+            for name, why in state.items():
+                if d and (df.is_name_or_prefix(d, name)
+                          or df.is_name_or_prefix(name, d)):
+                    return why
+            return None
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return None
+        for child in ast.iter_child_nodes(expr):
+            reason = self._expr_reason(child, state)
+            if reason is not None:
+                return reason
+        return None
+
+    def _update_taint(self, stmt: ast.AST, state: Dict[str, str]) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            reason = self._expr_reason(value, state) if value is not None \
+                else None
+            for t in targets:
+                for d in df.bound_names(t):
+                    if reason is not None:
+                        state[d] = reason
+                    elif not isinstance(stmt, ast.AugAssign):
+                        state.pop(d, None)  # reassignment kills
+
+    # --- collective-effect detection + reporting ---
+
+    def _effect_label(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(label, via-qualname) when the call performs/inherits a
+        collective effect."""
+        label = df.collective_effect_label(call, self.ckptrs)
+        if label is not None:
+            return (label, "")
+        target = self.scan.graph.resolve_call(self.fn, call)
+        if target is None or _is_sanctioned(target):
+            return None
+        summ = self.scan.summaries.get(target.key)
+        if summ is not None and summ.collective:
+            label = next(iter(sorted(summ.collective)))
+            return (label, target.qualname)
+        return None
+
+    def _flag(self, node: ast.AST, reason: str) -> None:
+        for n in _walk_pruned(node):
+            if not isinstance(n, ast.Call):
+                continue
+            hit = self._effect_label(n)
+            if hit is None:
+                continue
+            label, via = hit
+            if (n.lineno, label) in self.flagged:
+                continue
+            self.flagged.add((n.lineno, label))
+            detail = f"divergent control: {reason}"
+            if via:
+                detail += f"; effect inherited via {via}"
+            self.findings.append(Finding(
+                rule=RULE, path=self.fn.ctx.rel, line=n.lineno,
+                symbol=self.fn.qualname, detail=detail,
+                message=(f"{label} executes under process-divergent "
+                         f"control ({reason}) — every process must "
+                         "run the same collective sequence or the "
+                         "cohort deadlocks; hoist it out of the "
+                         "divergent region, or make this an audited "
+                         "seam (rules/spmd_divergence.py docstring)")))
+
+    def _flag_ifexp_arms(self, stmt: ast.AST,
+                         state: Dict[str, str]) -> None:
+        """`x = psum(...) if process_index() == 0 else y` — divergence
+        expressed as a ternary inside an otherwise-uniform statement."""
+        for n in _walk_pruned(stmt):
+            if isinstance(n, ast.IfExp):
+                reason = self._expr_reason(n.test, state)
+                if reason is not None:
+                    self._flag(n.body, f"branch on {reason}")
+                    self._flag(n.orelse, f"branch on {reason}")
+
+    # --- the walk ---
+
+    def walk(self, body: List[ast.stmt], state: Dict[str, str],
+             divergent: Optional[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                reason = self._expr_reason(stmt.test, state)
+                arm_div = divergent or (
+                    f"branch on {reason}" if reason else None)
+                if divergent:
+                    self._flag(stmt.test, divergent)
+                self.walk(stmt.body, dict(state), arm_div)
+                self.walk(stmt.orelse, dict(state), arm_div)
+                if reason and not divergent:
+                    # a divergent early exit poisons the remainder
+                    if _terminates(stmt.body) and not stmt.orelse:
+                        divergent = (f"code after a process-divergent "
+                                     f"early exit (branch on {reason})")
+                    elif stmt.orelse and _terminates(stmt.orelse) \
+                            and not _terminates(stmt.body):
+                        divergent = (f"code after a process-divergent "
+                                     f"early exit (branch on {reason})")
+                continue
+            if isinstance(stmt, (ast.While,)):
+                reason = self._expr_reason(stmt.test, state)
+                body_div = divergent or (
+                    f"loop bounded by {reason}" if reason else None)
+                if divergent:
+                    self._flag(stmt.test, divergent)
+                self.walk(stmt.body, dict(state), body_div)
+                self.walk(stmt.orelse, dict(state), body_div)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                reason = self._expr_reason(stmt.iter, state)
+                body_div = divergent or (
+                    f"loop over {reason}" if reason else None)
+                if divergent:
+                    self._flag(stmt.iter, divergent)
+                inner = dict(state)
+                if reason:
+                    for d in df.bound_names(stmt.target):
+                        inner[d] = reason
+                self.walk(stmt.body, inner, body_div)
+                self.walk(stmt.orelse, dict(state), body_div)
+                continue
+            if isinstance(stmt, ast.Try):
+                self.walk(stmt.body, dict(state), divergent)
+                for h in stmt.handlers:
+                    h_div = divergent
+                    if h_div is None and not _uniform_handler(h):
+                        h_div = ("an exception handler only the "
+                                 "process(es) that raised can take")
+                    self.walk(h.body, dict(state), h_div)
+                self.walk(stmt.orelse, dict(state), divergent)
+                self.walk(stmt.finalbody, dict(state), divergent)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if divergent:
+                    for item in stmt.items:
+                        self._flag(item.context_expr, divergent)
+                self.walk(stmt.body, state, divergent)
+                continue
+            if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                reason = self._expr_reason(stmt.subject, state)
+                case_div = divergent or (
+                    f"match on {reason}" if reason else None)
+                for case in stmt.cases:
+                    self.walk(case.body, dict(state), case_div)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # a nested frame is scanned as its own fn
+            # leaf statement
+            if divergent:
+                self._flag(stmt, divergent)
+            else:
+                self._flag_ifexp_arms(stmt, state)
+            self._update_taint(stmt, state)
+
+
+@register
+class SpmdDivergenceRule(Rule):
+    name = RULE
+    description = ("a collective effect (lax collective / shard_map / "
+                   "jax.distributed init / orbax checkpoint IO / async "
+                   "writer submit-wait, directly or via a callee's "
+                   "summary) under process-divergent control — "
+                   "process_index()-style branches, divergent early "
+                   "exits, exception handlers")
+
+    def check_scan(self, scan: Scan) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in scan.functions:
+            if _is_sanctioned(fn):
+                continue
+            _FnScan(fn, scan, findings).walk(
+                list(fn.node.body), {}, None)
+        return findings
