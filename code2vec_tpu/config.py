@@ -348,6 +348,18 @@ class Config:
     # sweep never touches the hot path either way).
     HEALTH_EVERY_S: float = 1.0
 
+    # ---- sampled phase attribution (code2vec_tpu/obs/phases.py,
+    # ISSUE 15): --phase_profile on dispatches one step in every
+    # --phase_sample_every through a phase-split path (each phase its
+    # own synced dispatch over the training/phase_probes.py prefixes;
+    # the state update stays the fused dispatch, so the trajectory is
+    # bit-identical to an unprofiled run) and publishes per-phase
+    # `train/phase/<p>_ms` timers + live `health/phase_*` roofline
+    # gauges. Off (default): one boolean check per step. Needs a live
+    # registry: --telemetry_dir or --metrics_port.
+    PHASE_PROFILE: str = "off"   # "off" | "on"
+    PHASE_SAMPLE_EVERY: int = 64
+
     # ---- deterministic fault injection (code2vec_tpu/resilience/,
     # ISSUE 10): --faults <file-or-inline-json> arms the seeded
     # failpoint registry (sites: ckpt/write, infeed/produce,
@@ -617,6 +629,21 @@ class Config:
                        help="JSON rule file replacing the built-in "
                             "alert rules (threshold + multi-window "
                             "burn-rate; see README)")
+        p.add_argument("--phase_profile", dest="phase_profile",
+                       default=None, choices=["off", "on"],
+                       help="sampled per-phase device timing: every "
+                            "--phase_sample_every steps one step runs "
+                            "phase-split (synced per-phase dispatches; "
+                            "the state update stays the fused step) "
+                            "and publishes train/phase/* timers + "
+                            "health_phase_* roofline gauges (needs "
+                            "--telemetry_dir or --metrics_port)")
+        p.add_argument("--phase_sample_every",
+                       dest="phase_sample_every", type=int,
+                       default=None,
+                       help="steps between phase-split samples "
+                            "(default 64; the non-sampled hot path is "
+                            "untouched)")
         p.add_argument("--serve_batch_max", dest="serve_batch_max",
                        type=int, default=None,
                        help="max methods per coalesced serving batch "
@@ -794,6 +821,10 @@ class Config:
             cfg.ALERTS_MODE = ns.alerts_mode
         if ns.alerts_rules is not None:
             cfg.ALERTS_RULES = ns.alerts_rules
+        if ns.phase_profile is not None:
+            cfg.PHASE_PROFILE = ns.phase_profile
+        if ns.phase_sample_every is not None:
+            cfg.PHASE_SAMPLE_EVERY = ns.phase_sample_every
         if ns.serve_batch_max is not None:
             cfg.SERVE_BATCH_MAX = ns.serve_batch_max
         if ns.serve_batch_timeout_ms is not None:
@@ -963,6 +994,18 @@ class Config:
                 "would be silently ignored.")
         if self.HEALTH_EVERY_S <= 0:
             raise ValueError("HEALTH_EVERY_S must be positive.")
+        if self.PHASE_PROFILE not in ("off", "on"):
+            raise ValueError(
+                "--phase_profile must be off or on "
+                f"(got {self.PHASE_PROFILE!r}).")
+        if self.PHASE_SAMPLE_EVERY < 1:
+            raise ValueError("--phase_sample_every must be >= 1.")
+        if self.PHASE_PROFILE == "on" and not self.TELEMETRY_DIR \
+                and self.METRICS_PORT <= 0:
+            raise ValueError(
+                "--phase_profile on needs a live registry: pass "
+                "--telemetry_dir (persisted phase events) or "
+                "--metrics_port (in-memory, scrape-only).")
         if self.LR_WARMUP_STEPS < 0:
             raise ValueError("--warmup_steps must be >= 0.")
         if self.INFEED_PREFETCH < 0:

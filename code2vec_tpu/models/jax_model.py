@@ -416,6 +416,17 @@ class Code2VecModel(Code2VecModelBase):
                         emit=False, static=True)
         model_shards = 1 if self.mesh is None else \
             int(self.mesh.shape.get(MODEL_AXIS, 1))
+        # shared analytic-model inputs (the floor gauges below AND the
+        # phase comparator): derived once so the two planes cannot
+        # disagree about the same quantity
+        ns = cfg.NUM_SAMPLED_CLASSES if cfg.USE_SAMPLED_SOFTMAX else 0
+        if self.mesh is None:
+            data_shards = 1
+        else:
+            data_shards = max(1, int(
+                self.mesh.shape.get(DCN_AXIS, 1)
+                * self.mesh.shape.get(DATA_AXIS, 1)))
+        procs = jax.process_count()
         if cfg.SPARSE_EMBEDDING_UPDATES and model_shards == 1:
             # live optimizer-efficiency plane (round 13): publish the
             # [U, E]-aware analytic step floor once; the health
@@ -436,18 +447,9 @@ class Code2VecModel(Code2VecModelBase):
             # reading false-good/bad.)
             from code2vec_tpu.training.sparse_update import (
                 sparse_step_floor_bytes, sparse_update_phase_bytes)
-            ns = cfg.NUM_SAMPLED_CLASSES if cfg.USE_SAMPLED_SOFTMAX \
-                else 0
-            if self.mesh is None:
-                data_shards = 1
-            else:
-                data_shards = int(
-                    self.mesh.shape.get(DCN_AXIS, 1)
-                    * self.mesh.shape.get(DATA_AXIS, 1))
-            procs = jax.process_count()
             step_bytes = sparse_step_floor_bytes(
                 self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
-                num_sampled=ns, data_shards=max(1, data_shards),
+                num_sampled=ns, data_shards=data_shards,
                 processes=procs)
             upd_bytes = sparse_update_phase_bytes(
                 self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
@@ -461,6 +463,44 @@ class Code2VecModel(Code2VecModelBase):
             telemetry.gauge("train/sparse_update_floor_ms",
                             upd_bytes / ceiling * 1e3, emit=False,
                             static=True)
+        # sampled phase attribution (--phase_profile, ISSUE 15): every
+        # PHASE_SAMPLE_EVERY steps one step dispatches phase-split
+        # (synced probe prefixes for attribution, the fused step for
+        # the state update — trajectory bit-identical to unprofiled);
+        # off, the loop pays one boolean check per step. Probes build
+        # + warm lazily at the first sampled step.
+        from code2vec_tpu.obs.phases import PhaseProfiler
+        phase_kw = {}
+        if cfg.PHASE_PROFILE == "on" and telemetry.enabled \
+                and model_shards == 1:
+            # the analytic per-phase comparator (model-sharded tables
+            # are not described by it — same rule as the floor gauges
+            # above: no gauge beats a false one)
+            from code2vec_tpu.training.sparse_update import \
+                phase_traffic_bytes
+            phase_kw["phase_bytes"] = phase_traffic_bytes(
+                self.params, cfg.TRAIN_BATCH_SIZE, cfg.MAX_CONTEXTS,
+                num_sampled=ns, sparse=cfg.SPARSE_EMBEDDING_UPDATES,
+                data_shards=data_shards, processes=procs)
+            phase_kw["ceiling_gbps"] = cfg.HBM_CEILING_GBPS
+
+        def _phase_probes():
+            from code2vec_tpu.training.phase_probes import \
+                make_code2vec_probes
+            return make_code2vec_probes(
+                self.dims, self.optimizer,
+                use_sampled_softmax=cfg.USE_SAMPLED_SOFTMAX,
+                num_sampled=cfg.NUM_SAMPLED_CLASSES,
+                compute_dtype=self.compute_dtype,
+                use_pallas=self.use_pallas, mesh=self.mesh,
+                sparse_updates=cfg.SPARSE_EMBEDDING_UPDATES)
+
+        phase_profiler = PhaseProfiler.create(
+            telemetry, fused_step=self._train_step,
+            probes_factory=_phase_probes,
+            enabled=cfg.PHASE_PROFILE == "on",
+            sample_every=cfg.PHASE_SAMPLE_EVERY, log=self.log,
+            **phase_kw)
         loop_hb.busy()  # the first deadline covers step-0 compile too
         steps_into_training = 0
         # Double-buffered infeed (SURVEY.md §3.3): host parse +
@@ -496,8 +536,24 @@ class Code2VecModel(Code2VecModelBase):
                     # recovery replays the trajectory bit-for-bit
                     step_rng = jax.random.fold_in(self.rng,
                                                   self.step_num)
-                    self.params, self.opt_state, loss = self._train_step(
-                        self.params, self.opt_state, dev_batch, step_rng)
+                    if phase_profiler.enabled \
+                            and phase_profiler.should_sample(
+                                steps_into_training):
+                        # sampled: probes first (measurement-only),
+                        # then the fused dispatch for the real update
+                        self.params, self.opt_state, loss = \
+                            phase_profiler.run_split(
+                                self.params, self.opt_state, dev_batch,
+                                step_rng, step=self.step_num,
+                                infeed_wait_ms=recorder.infeed_wait_ms
+                                if recorder.enabled else None,
+                                recorder=recorder
+                                if recorder.enabled else None)
+                    else:
+                        self.params, self.opt_state, loss = \
+                            self._train_step(self.params,
+                                             self.opt_state, dev_batch,
+                                             step_rng)
                     if nan_fp.armed and nan_fp.hit():
                         loss = loss * float("nan")  # poison the loss
                     if kill_fp.armed:

@@ -76,7 +76,8 @@ class StdoutSink:
     gauges, summaries) through the run's logger."""
 
     def __init__(self, log: Callable[[str], None],
-                 skip_kinds: Sequence[str] = ("step", "span")):
+                 skip_kinds: Sequence[str] = ("step", "span",
+                                              "phase")):
         self._log = log
         self._skip = frozenset(skip_kinds)
 

@@ -65,7 +65,14 @@ def device_sync(tree) -> None:
 
     try:
         leaf = jax.tree_util.tree_leaves(tree)[0]
-        float(jnp.sum(leaf[..., :1].astype(jnp.float32)))
+        # 0-d leaves (a bare loss scalar — the phase probes'
+        # forward/backward outputs) have no axis to slice; indexing one
+        # would raise and silently demote this sync to the unreliable
+        # block_until_ready path. Everything else keeps the last-axis
+        # sliver: the transferred probe must stay O(tiny) or the sync
+        # itself would skew the timings it bounds.
+        probe = leaf if getattr(leaf, "ndim", 1) == 0 else leaf[..., :1]
+        float(jnp.sum(probe.astype(jnp.float32)))
     except Exception:
         jax.block_until_ready(tree)
 

@@ -1,0 +1,271 @@
+"""Measurement-only jitted prefixes of the train step (ISSUE 15).
+
+obs/phases.PhaseProfiler dispatches these, each its own synced call,
+on a sampled step; bench.py slope-times the same chain for the
+per-round `phase_*` breakdown — one probe construction, so the
+sampled in-train attribution and the offline bench attribution can
+never measure different math. The chain is CUMULATIVE (probe k
+re-runs probes 1..k-1 plus one more stage); the profiler differences
+consecutive synced times into per-phase device ms
+(obs/phases.derive_chain_phases is the shared rule).
+
+Probe outputs are DISCARDED — the sampled step's state update is the
+fused dispatch (obs/phases.py module docstring: "sample the split,
+trust the fused"). Prefix math comes from the step's own building
+blocks: the dense chain re-runs `make_train_loss_fn` (the exact
+function the fused step differentiates), the sparse chain re-runs
+`sparse_steps.prepare_step_inputs`/`make_gathered_loss` (the exact
+helpers `step_impl` calls). The concat/dense prefix stops after the
+TRANSFORM matmul (tanh(contexts @ T)) — the last point before the
+attention-softmax-pool — mirroring ops/attention.attention_pool's
+first stage.
+
+int8 tables: the chain stops at the forward (differentiating the
+{q, s} dicts needs the fused step's carrier plumbing), so backward +
+apply report as one `backward_apply` remainder — a documented
+degradation, not a wrong number.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from code2vec_tpu.models.encoder import ModelDims, take_rows
+from code2vec_tpu.obs.phases import ProbeKit
+
+__all__ = ["make_code2vec_probes", "make_vm_probes"]
+
+
+def _dropout(contexts, rng, keep_rate: float):
+    if keep_rate >= 1.0:
+        return contexts
+    keep = jax.random.bernoulli(rng, keep_rate, contexts.shape)
+    return jnp.where(keep, contexts / keep_rate, 0.0)
+
+
+def _make_dense_apply(optimizer):
+    """Isolated optimizer apply over the fwd_bwd probe's gradients —
+    exactly make_train_step's apply section, timed alone."""
+
+    @jax.jit
+    def apply_probe(params, opt_state, grads):
+        updates, new_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def apply_fn(params, opt_state, _batch, _rng, chain_out):
+        _loss, grads = chain_out
+        return apply_probe(params, opt_state, grads)
+
+    return apply_fn
+
+
+def _make_allreduce(mesh) -> Optional[Callable]:
+    """Isolated grads-shaped all-reduce over the mesh's composite batch
+    axes — the comm's fully-exposed cost (obs/phases.py derives the
+    exposed-vs-overlapped pair from it). None when the mesh has no
+    batch sharding (nothing to reduce)."""
+    from code2vec_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if shape.get(DCN_AXIS, 1) * shape.get(DATA_AXIS, 1) <= 1:
+        return None
+    from code2vec_tpu.parallel.compat import shard_map
+    P = jax.sharding.PartitionSpec
+
+    def body(tree):
+        return jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, (DCN_AXIS, DATA_AXIS)), tree)
+
+    # replicated in/out: every device holds the full grads tree, the
+    # psum is the allreduce pattern the GSPMD backward inserts (the
+    # summed VALUES are n_devices x grads — discarded, only the comm
+    # is being timed)
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                           out_specs=P()))
+
+    def allreduce_fn(chain_out):
+        return fn(chain_out[1])
+
+    return allreduce_fn
+
+
+def make_code2vec_probes(dims: ModelDims, optimizer, *,
+                         use_sampled_softmax: bool = False,
+                         num_sampled: int = 4096,
+                         compute_dtype=jnp.float32,
+                         use_pallas: bool = False, mesh=None,
+                         sparse_updates: bool = False) -> ProbeKit:
+    """The code2vec head's probe kit, mirroring make_train_step's
+    dispatch: the sparse chain when `sparse_updates` (gathered-row
+    granularity — its backward emits NO dense carrier, exactly like
+    the step), the dense chain otherwise."""
+    if sparse_updates:
+        return _sparse_kit(dims, use_sampled_softmax=use_sampled_softmax,
+                           num_sampled=num_sampled,
+                           compute_dtype=compute_dtype)
+    return _dense_kit(dims, optimizer,
+                      use_sampled_softmax=use_sampled_softmax,
+                      num_sampled=num_sampled,
+                      compute_dtype=compute_dtype,
+                      use_pallas=use_pallas, mesh=mesh)
+
+
+def _dense_kit(dims, optimizer, *, use_sampled_softmax, num_sampled,
+               compute_dtype, use_pallas, mesh) -> ProbeKit:
+    from code2vec_tpu.training.steps import make_train_loss_fn
+    loss_fn = make_train_loss_fn(
+        dims, use_sampled_softmax=use_sampled_softmax,
+        num_sampled=num_sampled, compute_dtype=compute_dtype,
+        use_pallas=use_pallas, mesh=mesh)
+
+    @jax.jit
+    def embed_gather(params, batch, _rng):
+        _l, src, pth, dst, _m, _w = batch
+        return (take_rows(params, "token_emb", src),
+                take_rows(params, "path_emb", pth),
+                take_rows(params, "token_emb", dst))
+
+    chain = [("embed_gather", embed_gather)]
+
+    if dims.encoder_type == "bag":
+        @jax.jit
+        def concat_dense(params, batch, rng):
+            _l, src, pth, dst, _m, _w = batch
+            contexts = jnp.concatenate(
+                [take_rows(params, "token_emb", src),
+                 take_rows(params, "path_emb", pth),
+                 take_rows(params, "token_emb", dst)],
+                axis=-1).astype(compute_dtype)
+            drop_rng, _sample_rng = jax.random.split(rng)
+            contexts = _dropout(contexts, drop_rng,
+                                dims.dropout_keep_rate)
+            return jnp.tanh(contexts
+                            @ params["transform"].astype(contexts.dtype))
+
+        chain.append(("concat_dense", concat_dense))
+    # transformer encoder: no pre-attention seam to stop at — the
+    # concat/dense stage folds into forward_pool
+
+    chain.append(("forward_pool", jax.jit(loss_fn)))
+
+    if dims.tables_dtype == "int8":
+        # no backward probe (the {q, s} grads need the fused step's
+        # straight-through carriers): backward + apply report as one
+        # remainder
+        return ProbeKit(chain, remainder_name="backward_apply")
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    chain.append(("backward", lambda p, b, r: grad_fn(p, b, r)))
+    allreduce_fn = _make_allreduce(mesh) if mesh is not None else None
+    if allreduce_fn is None:
+        # single-device: table_apply is the fused remainder — exact
+        # there (fused = chain + apply, nothing else runs), and it
+        # keeps the per-sample cost to the chain alone (the ≤2%
+        # sampling-overhead budget at --phase_sample_every 64)
+        return ProbeKit(chain)
+    # mesh: the isolated apply probe is what lets the exposed-comm
+    # derivation separate allreduce from apply (obs/phases.py). This
+    # kit measures every phase directly, so no derived remainder —
+    # table_apply stays the MEASURED apply and the kit publishes the
+    # real residual (the in-fused comm the split cannot see) instead
+    # of silently absorbing it into table_apply.
+    return ProbeKit(chain, apply_fn=_make_dense_apply(optimizer),
+                    allreduce_fn=allreduce_fn, derive_remainder=False)
+
+
+def _sparse_kit(dims, *, use_sampled_softmax, num_sampled,
+                compute_dtype) -> ProbeKit:
+    """The sparse (--sparse_embeddings) chain over sparse_steps' own
+    helpers. No apply probe: the dedup/segment-sum/live-row apply is
+    entangled with the step's rng/count threading, so it reports as
+    the fused remainder (`table_apply = fused - chain`) — under a mesh
+    that remainder also carries mesh_sparse_apply's per-occurrence
+    all-gathers."""
+    from code2vec_tpu.training.sparse_steps import (make_gathered_loss,
+                                                    prepare_step_inputs)
+    S = min(num_sampled, dims.target_vocab_size)
+    V = dims.target_vocab_size
+    prep = functools.partial(prepare_step_inputs,
+                             use_sampled_softmax=use_sampled_softmax,
+                             num_sampled=S, target_vocab=V)
+
+    @jax.jit
+    def embed_gather(params, batch, rng):
+        _dense, gathered, _ctx = prep(params, batch, rng)
+        return gathered
+
+    @jax.jit
+    def concat_dense(params, batch, rng):
+        dense, gathered, ctx = prep(params, batch, rng)
+        contexts = jnp.concatenate(
+            [gathered["src_e"], gathered["pth_e"], gathered["dst_e"]],
+            axis=-1).astype(compute_dtype)
+        contexts = _dropout(contexts, ctx["drop_rng"],
+                            dims.dropout_keep_rate)
+        return jnp.tanh(contexts
+                        @ dense["transform"].astype(contexts.dtype))
+
+    def _loss(params, batch, rng):
+        dense, gathered, ctx = prep(params, batch, rng)
+        loss_fn = make_gathered_loss(
+            dims, ctx, use_sampled_softmax=use_sampled_softmax,
+            compute_dtype=compute_dtype)
+        return loss_fn, dense, gathered
+
+    @jax.jit
+    def forward_pool(params, batch, rng):
+        loss_fn, dense, gathered = _loss(params, batch, rng)
+        return loss_fn(dense, gathered)
+
+    @jax.jit
+    def backward(params, batch, rng):
+        loss_fn, dense, gathered = _loss(params, batch, rng)
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(dense,
+                                                           gathered)
+
+    return ProbeKit([("embed_gather", embed_gather),
+                     ("concat_dense", concat_dense),
+                     ("forward_pool", forward_pool),
+                     ("backward", backward)])
+
+
+def make_vm_probes(dims: ModelDims, *, compute_dtype=jnp.float32,
+                   use_pallas: bool = False) -> ProbeKit:
+    """The varmisuse head's probe kit (vm_steps.make_vm_train_step's
+    shape): gather → forward → backward, with table_apply as the fused
+    remainder on BOTH the dense and sparse apply paths (the remainder
+    covers whichever apply the fused step runs, so the kit needs
+    neither the optimizer nor the sparse flag). The vm loss gathers
+    inside the differentiated function (its backward emits the dense
+    cotangent), so there is no pre-attention concat/dense seam to
+    probe."""
+    from code2vec_tpu.models.varmisuse import vm_loss
+
+    def loss_fn(params, batch, rng):
+        return vm_loss(params, batch, dropout_rng=rng,
+                       dropout_keep_rate=dims.dropout_keep_rate,
+                       compute_dtype=compute_dtype,
+                       use_pallas=use_pallas)
+
+    @jax.jit
+    def embed_gather(params, batch, _rng):
+        _l, src, pth, dst, _m, cand, _cm, _w = batch
+        return (take_rows(params, "token_emb", src),
+                take_rows(params, "path_emb", pth),
+                take_rows(params, "token_emb", dst),
+                take_rows(params, "token_emb", cand))
+
+    chain = [("embed_gather", embed_gather),
+             ("forward_pool", jax.jit(loss_fn))]
+    if dims.tables_dtype == "int8":
+        return ProbeKit(chain, remainder_name="backward_apply")
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    chain.append(("backward", lambda p, b, r: grad_fn(p, b, r)))
+    # table_apply = fused remainder on both vm paths (the dense-apply
+    # probe exists for the mesh exposed-comm derivation, which the vm
+    # head does not wire) — keeps the sampling-overhead budget
+    return ProbeKit(chain)

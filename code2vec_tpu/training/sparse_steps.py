@@ -76,6 +76,94 @@ def _gather_rows(table, ids):
     return jnp.take(table, ids, axis=0)
 
 
+def prepare_step_inputs(params, batch, rng, *, use_sampled_softmax:
+                        bool, num_sampled: int, target_vocab: int):
+    """The sparse step's non-differentiated preliminaries + gathers —
+    extracted from `step_impl` so the phase probes
+    (training/phase_probes.py, ISSUE 15) measure EXACTLY the gathers
+    the step performs, never a drifted copy. Returns
+    `(dense, gathered, ctx)`: the dense-param dict, the gathered-row
+    dict autodiff differentiates, and a ctx dict carrying everything
+    `make_gathered_loss` and the apply section need (drop_rng, qrngs,
+    sampled ids + sampled-softmax corrections)."""
+    labels, src, pth, dst, mask, weights = batch
+    qkeys = sorted(k for k in ("token_emb", "path_emb")
+                   if is_quantized(params[k]))
+    drop_rng, sample_rng, *qrngs = jax.random.split(
+        rng, 2 + len(qkeys))
+    ctx = {"drop_rng": drop_rng, "qrngs": dict(zip(qkeys, qrngs)),
+           "labels": labels, "mask": mask, "weights": weights}
+
+    if use_sampled_softmax:
+        S, V = num_sampled, target_vocab
+        sampled = log_uniform_sample(sample_rng, S, V)            # [S]
+        ctx["sampled"] = sampled
+        ctx["true_corr"] = _log_expected_count(labels, S, V)      # [B]
+        ctx["samp_corr"] = _log_expected_count(sampled, S, V)     # [S]
+        ctx["accidental"] = sampled[None, :] == labels[:, None]   # [B,S]
+
+    # ---- gathers OUTSIDE the differentiated function ----
+    gathered = {"src_e": _gather_rows(params["token_emb"], src),
+                "pth_e": _gather_rows(params["path_emb"], pth),
+                "dst_e": _gather_rows(params["token_emb"], dst)}
+    if use_sampled_softmax:
+        gathered["true_w"] = _gather_rows(params["target_emb"], labels)
+        gathered["samp_w"] = _gather_rows(params["target_emb"],
+                                          ctx["sampled"])
+
+    dense_keys = ["transform", "attention"]
+    if not use_sampled_softmax:
+        dense_keys.append("target_emb")
+    dense = {k: params[k] for k in dense_keys}
+    return dense, gathered, ctx
+
+
+def make_gathered_loss(dims: ModelDims, ctx, *, use_sampled_softmax:
+                       bool, compute_dtype):
+    """`loss_fn(dense, gathered)` over prepare_step_inputs' outputs —
+    the exact function the sparse step differentiates (and the phase
+    probes' forward/backward prefixes re-run)."""
+    V = dims.target_vocab_size
+    mask, weights = ctx["mask"], ctx["weights"]
+
+    def loss_fn(dense, gathered):
+        contexts = jnp.concatenate(
+            [gathered["src_e"], gathered["pth_e"], gathered["dst_e"]],
+            axis=-1).astype(compute_dtype)
+        if dims.dropout_keep_rate < 1.0:
+            keep = jax.random.bernoulli(
+                ctx["drop_rng"], dims.dropout_keep_rate,
+                contexts.shape)
+            contexts = jnp.where(keep,
+                                 contexts / dims.dropout_keep_rate,
+                                 0.0)
+        code, _ = attention_pool(contexts, dense["transform"],
+                                 dense["attention"], mask)
+        if use_sampled_softmax:
+            true_w = gathered["true_w"].astype(code.dtype)
+            samp_w = gathered["samp_w"].astype(code.dtype)
+            true_logits = jnp.sum(code * true_w, axis=-1).astype(
+                jnp.float32) - ctx["true_corr"]
+            samp_logits = (code @ samp_w.T).astype(
+                jnp.float32) - ctx["samp_corr"][None, :]
+            samp_logits = jnp.where(ctx["accidental"], -1e9,
+                                    samp_logits)
+            logits = jnp.concatenate(
+                [true_logits[:, None], samp_logits], axis=1)
+            per_ex = -jax.nn.log_softmax(logits, axis=-1)[:, 0]
+        else:
+            table = dense["target_emb"].astype(code.dtype)
+            logits = (code @ table.T).astype(jnp.float32)
+            col = jnp.arange(table.shape[0])
+            logits = jnp.where(col[None, :] < V, logits, -1e9)
+            per_ex = optax.softmax_cross_entropy_with_integer_labels(
+                logits, ctx["labels"])
+        denom = jnp.maximum(jnp.sum(weights), 1.0)
+        return jnp.sum(per_ex * weights) / denom
+
+    return loss_fn
+
+
 def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
                            dense_optimizer: optax.GradientTransformation
                            | None = None,
@@ -116,68 +204,18 @@ def make_sparse_train_step(dims: ModelDims, *, learning_rate: float,
 
     def step_impl(params, opt_state, batch, rng):
         labels, src, pth, dst, mask, weights = batch
-        B, C = src.shape
-        qkeys = sorted(k for k in ("token_emb", "path_emb")
-                       if is_quantized(params[k]))
-        drop_rng, sample_rng, *qrngs = jax.random.split(
-            rng, 2 + len(qkeys))
-        qrngs = dict(zip(qkeys, qrngs))
-
-        # ---- non-differentiated preliminaries ----
-        if use_sampled_softmax:
-            sampled = log_uniform_sample(sample_rng, S, V)          # [S]
-            true_corr = _log_expected_count(labels, S, V)           # [B]
-            samp_corr = _log_expected_count(sampled, S, V)          # [S]
-            accidental = sampled[None, :] == labels[:, None]        # [B,S]
-
-        # ---- gathers OUTSIDE the differentiated function ----
-        src_e = _gather_rows(params["token_emb"], src)
-        dst_e = _gather_rows(params["token_emb"], dst)
-        pth_e = _gather_rows(params["path_emb"], pth)
-        gathered = {"src_e": src_e, "pth_e": pth_e, "dst_e": dst_e}
-        if use_sampled_softmax:
-            gathered["true_w"] = _gather_rows(params["target_emb"],
-                                              labels)
-            gathered["samp_w"] = _gather_rows(params["target_emb"],
-                                              sampled)
-
-        dense_keys = ["transform", "attention"]
-        if not use_sampled_softmax:
-            dense_keys.append("target_emb")
-        dense = {k: params[k] for k in dense_keys}
-
-        def loss_fn(dense, gathered):
-            contexts = jnp.concatenate(
-                [gathered["src_e"], gathered["pth_e"], gathered["dst_e"]],
-                axis=-1).astype(compute_dtype)
-            if dims.dropout_keep_rate < 1.0:
-                keep = jax.random.bernoulli(
-                    drop_rng, dims.dropout_keep_rate, contexts.shape)
-                contexts = jnp.where(keep,
-                                     contexts / dims.dropout_keep_rate,
-                                     0.0)
-            code, _ = attention_pool(contexts, dense["transform"],
-                                     dense["attention"], mask)
-            if use_sampled_softmax:
-                true_w = gathered["true_w"].astype(code.dtype)
-                samp_w = gathered["samp_w"].astype(code.dtype)
-                true_logits = jnp.sum(code * true_w, axis=-1).astype(
-                    jnp.float32) - true_corr
-                samp_logits = (code @ samp_w.T).astype(
-                    jnp.float32) - samp_corr[None, :]
-                samp_logits = jnp.where(accidental, -1e9, samp_logits)
-                logits = jnp.concatenate(
-                    [true_logits[:, None], samp_logits], axis=1)
-                per_ex = -jax.nn.log_softmax(logits, axis=-1)[:, 0]
-            else:
-                table = dense["target_emb"].astype(code.dtype)
-                logits = (code @ table.T).astype(jnp.float32)
-                col = jnp.arange(table.shape[0])
-                logits = jnp.where(col[None, :] < V, logits, -1e9)
-                per_ex = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels)
-            denom = jnp.maximum(jnp.sum(weights), 1.0)
-            return jnp.sum(per_ex * weights) / denom
+        # preliminaries + gathers + the differentiated loss live in
+        # module-level helpers shared with the ISSUE-15 phase probes
+        # (training/phase_probes.py): ONE definition, so a sampled
+        # phase-split prefix can never measure drifted math
+        dense, gathered, ctx = prepare_step_inputs(
+            params, batch, rng, use_sampled_softmax=use_sampled_softmax,
+            num_sampled=S, target_vocab=V)
+        qrngs = ctx["qrngs"]
+        sampled = ctx.get("sampled")
+        loss_fn = make_gathered_loss(
+            dims, ctx, use_sampled_softmax=use_sampled_softmax,
+            compute_dtype=compute_dtype)
 
         loss, (g_dense, g_rows) = jax.value_and_grad(
             loss_fn, argnums=(0, 1))(dense, gathered)

@@ -270,3 +270,37 @@ def test_multichip_repo_trajectory_accepted():
                    pattern="MULTICHIP_r*.json")
     assert rc in (0, 2)
     assert all(r["status"] != "REGRESSION" for r in rows)
+
+
+def test_bench_r06_with_phase_breakdown_passes_real_trajectory(
+        tmp_path):
+    """ISSUE 15 satellite (the round-13 TODO that keeps the trajectory
+    gate alive): a BENCH_r06 carrying the new phase_* breakdown must
+    pass the DEFAULT gate against the repo's real BENCH_r01–r05 —
+    the new keys have no history yet (skip, by the mixed-schema rule)
+    and the headline metrics gate on-trajectory values. The driver's
+    post-round bench capture is exactly this shape (bench.py now emits
+    phase_* every round)."""
+    import shutil
+
+    from tools.bench_regression import DEFAULT_METRICS
+    for n in range(1, 6):
+        shutil.copy(os.path.join(REPO, f"BENCH_r0{n}.json"),
+                    tmp_path / f"BENCH_r0{n}.json")
+    r06 = {"metric": "path-contexts/sec/chip", "value": 6700000.0,
+           "fwd_bwd_floor_pc_per_sec": 8500000.0,
+           "int8_pc_per_sec": 5400000.0,
+           "transformer_pc_per_sec": 2300000.0,
+           "sparse_pc_per_sec": 8400000.0,
+           "phase_embed_gather_ms": 4.1, "phase_concat_dense_ms": 3.0,
+           "phase_forward_pool_ms": 5.2, "phase_backward_ms": 9.0,
+           "phase_table_apply_ms": 6.4, "phase_sum_ms": 27.7}
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(r06))
+    rc, rows = run(str(tmp_path), list(DEFAULT_METRICS), band=0.05,
+                   window=5, min_history=2, strict=False)
+    assert rc == 0
+    by = {r["metric"]: r for r in rows}
+    assert by["value"]["status"] == "ok"
+    # phase keys: no prior history -> skipped this round, gated from
+    # the first round with 2+ phase-bearing predecessors
+    assert by["phase_backward_ms"]["status"] == "skip"

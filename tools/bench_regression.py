@@ -48,8 +48,21 @@ from typing import Any, Dict, List, Optional, Tuple
 # gates on the metrics it actually reports (older rounds effectively
 # gate on `value` and whatever else they carry); a missing or
 # non-numeric key is never fatal to the gate.
+# Per-phase attribution of the sparse step (ISSUE 15): bench.py emits
+# phase_<name>_ms every round; gated LOWER-is-better so a single phase
+# regressing 2x fails the gate even while the headline pc/s holds
+# (slack created by one phase's win can hide another's regression in
+# any whole-step figure). These literals are the canonical set;
+# default-set runs (no --metrics) additionally auto-gate ANY other
+# phase_*_ms key the rounds carry (a mesh capture's allreduce pair,
+# the int8 backward_apply remainder), so no phase escapes the gate.
+PHASE_MS_METRICS = ("phase_embed_gather_ms", "phase_concat_dense_ms",
+                    "phase_forward_pool_ms", "phase_backward_ms",
+                    "phase_table_apply_ms")
+
 DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
-                   "fwd_bwd_floor_pc_per_sec", "sparse_pc_per_sec")
+                   "fwd_bwd_floor_pc_per_sec", "sparse_pc_per_sec"
+                   ) + PHASE_MS_METRICS
 
 # The MULTICHIP trajectory (tools/multichip_bench.py, round 14):
 # scaling efficiency is the headline — a pod that got faster per chip
@@ -65,9 +78,15 @@ MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
 # Metrics where SMALLER is healthier: the band becomes a ceiling
 # (baseline * (1 + band)) instead of a floor. Everything else in the
 # gate — median baseline, MAD-widened band, history windowing — is
-# direction-agnostic.
+# direction-agnostic. Any phase_*_ms key rides the same direction via
+# _lower_is_better (per-phase device times are costs, not throughput).
 LOWER_IS_BETTER = frozenset({"recovery_steps_lost",
                              "recovery_seconds"})
+
+
+def _lower_is_better(metric: str) -> bool:
+    return metric in LOWER_IS_BETTER or (
+        metric.startswith("phase_") and metric.endswith("_ms"))
 
 KINDS = {
     "bench": ("BENCH_r*.json", DEFAULT_METRICS),
@@ -149,7 +168,7 @@ def check_metric(metric: str, history: List[Tuple[int, float]],
         return row
     values = [v for _r, v in history]
     baseline = _median(values)
-    lower_better = metric in LOWER_IS_BETTER
+    lower_better = _lower_is_better(metric)
     # a non-positive baseline means broken data for a throughput
     # metric — but for a lower-is-better COST metric, 0 is the best
     # possible baseline (perfect recovery) and any positive latest is
@@ -177,7 +196,8 @@ def check_metric(metric: str, history: List[Tuple[int, float]],
 
 def run(dir_path: str, metrics: List[str], band: float, window: int,
         min_history: int, strict: bool,
-        pattern: str = "BENCH_r*.json") -> Tuple[int, List[Dict]]:
+        pattern: str = "BENCH_r*.json",
+        auto_phases: bool = False) -> Tuple[int, List[Dict]]:
     rounds = load_rounds(dir_path, pattern)
     if not rounds:
         print(f"error: no {pattern} with results under "
@@ -185,6 +205,16 @@ def run(dir_path: str, metrics: List[str], band: float, window: int,
         return 2, []
     latest_round, latest = rounds[-1]
     prior = rounds[:-1]
+    if auto_phases:
+        # default-set runs gate EVERY phase_*_ms key the rounds carry,
+        # not just the PHASE_MS_METRICS literals: a future capture
+        # growing a phase (phase_allreduce_ms under a mesh, the int8
+        # backward_apply remainder) must not escape the gate the docs
+        # promise. An explicit --metrics list is respected as given.
+        metrics = list(metrics) + sorted({
+            k for _r, res in rounds for k in res
+            if _lower_is_better(k) and k.startswith("phase_")
+            and k not in metrics})
     rows = []
     for metric in metrics:
         latest_val = _num(latest, metric)
@@ -267,7 +297,8 @@ def main(argv=None) -> int:
     metrics = args.metrics if args.metrics is not None \
         else list(kind_metrics)
     rc, rows = run(args.dir, metrics, args.band, args.window,
-                   args.min_history, args.strict, pattern=pattern)
+                   args.min_history, args.strict, pattern=pattern,
+                   auto_phases=args.metrics is None)
     if rows:
         print(json.dumps(rows, indent=1) if args.json
               else render(rows))

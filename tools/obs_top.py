@@ -14,12 +14,17 @@ one endpoint each.
   python tools/obs_top.py localhost:9100 --once   # one sample, no TUI
 
 Rates (steps/s, examples/s, requests/s) are differenced between
-consecutive polls of each endpoint's cumulative counters;
-path-contexts/s = examples-rate × the `train_max_contexts` gauge the
-train loop publishes. Health verdicts, firing alerts, stalled
-components and stale gauges (age > --stale_s) come straight off the
-same scrape. Pure stdlib (urllib + re) — runs on a laptop against a
-pod with nothing installed.
+consecutive polls of each endpoint's cumulative counters; a counter
+that went BACKWARD means the process restarted (supervisor relaunch /
+elastic resize zeroes its counters) — the row is annotated RESTARTED
+and rates clamp to the new process's progress instead of rendering
+negative steps/s. path-contexts/s = examples-rate × the
+`train_max_contexts` gauge the train loop publishes. Health verdicts,
+firing alerts, stalled components and stale gauges (age > --stale_s)
+come straight off the same scrape; hosts running --phase_profile
+additionally get a per-phase p50 column set (ISSUE 15). Pure stdlib
+(urllib + re) — runs on a laptop against a pod with nothing
+installed.
 """
 
 from __future__ import annotations
@@ -35,6 +40,16 @@ from typing import Any, Dict, List, Optional, Tuple
 _LINE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+# canonical phase-column order: code2vec_tpu/obs/phases.py PHASE_ORDER
+# plus the trailing fused_step timer (kept literal here so this tool
+# stays runnable on a laptop with nothing installed; a test pins the
+# copy equal to the canonical tuple); unknown phases append
+# alphabetically
+_PHASE_ORDER = ("infeed_wait", "embed_gather", "concat_dense",
+                "forward_pool", "backward", "table_apply",
+                "backward_apply", "allreduce", "allreduce_exposed",
+                "fused_step")
 
 
 def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict, float]]]:
@@ -102,6 +117,7 @@ class EndpointState:
             self.error = str(getattr(e, "reason", e))
             return {"endpoint": self.endpoint, "error": self.error}
         prev, self.last = self.last, (t, metrics)
+        restarted: List[str] = []
 
         def rate(counter: str) -> Optional[float]:
             cur = scalar(metrics, counter)
@@ -111,7 +127,16 @@ class EndpointState:
             dt = t - prev[0]
             if old is None or dt <= 0:
                 return None
-            return max(0.0, cur - old) / dt
+            if cur < old:
+                # per-host counter reset: a supervisor restart or
+                # elastic resize replaced the process, zeroing its
+                # cumulative counters — the raw difference is negative
+                # garbage. Annotate the row and rate what the NEW
+                # process accumulated this window (cur since its zero),
+                # clamped >= 0, instead of rendering negative steps/s.
+                restarted.append(counter)
+                return max(0.0, cur) / dt
+            return (cur - old) / dt
 
         ex_rate = rate("train_examples")
         max_ctx = scalar(metrics, "train_max_contexts")
@@ -127,6 +152,14 @@ class EndpointState:
         stale = [labels.get("gauge", "?")
                  for labels, v in metrics.get("gauge_age_seconds", ())
                  if v > stale_s]
+        # sampled per-phase p50s (--phase_profile, ISSUE 15): one
+        # column per train_phase_<name>_ms summary the host exports
+        phases = {}
+        for fam in metrics:
+            if fam.startswith("train_phase_") and fam.endswith("_ms"):
+                v = labeled(metrics, fam, quantile="0.5")
+                if v is not None:
+                    phases[fam[len("train_phase_"):-3]] = v
         return {
             "endpoint": self.endpoint,
             "steps": scalar(metrics, "train_steps"),
@@ -150,6 +183,9 @@ class EndpointState:
             "alerts": firing,
             "unhealthy": unhealthy,
             "stale_gauges": stale,
+            "restarted": restarted,
+            "phases": phases,
+            "phase_coverage": scalar(metrics, "health_phase_coverage"),
         }
 
 
@@ -189,6 +225,10 @@ def render(rows: List[Dict[str, Any]]) -> str:
         bits = []
         if r["stalled"]:
             bits.append("STALLED:" + ",".join(r["stalled"]))
+        if r.get("restarted"):
+            # counter reset this window (supervisor restart / elastic
+            # resize): rates shown are the NEW process's, not deltas
+            bits.append("RESTARTED")
         if r["alerts"]:
             bits.append("ALERT:" + ",".join(r["alerts"]))
         if r["unhealthy"]:
@@ -203,7 +243,32 @@ def render(rows: List[Dict[str, Any]]) -> str:
             f"| {_f(r['req_s'])} | {_f(r['queue_depth'], 0)} "
             f"| {_f(r['loss'], 4)} "
             f"| {' '.join(bits) if bits else 'ok'} |")
+    phase_lines = render_phases(rows)
+    if phase_lines:
+        lines.append("")
+        lines.extend(phase_lines)
     return "\n".join(lines)
+
+
+def render_phases(rows: List[Dict[str, Any]]) -> List[str]:
+    """The per-phase column set (--phase_profile hosts): p50 device ms
+    per sampled phase, one row per host, columns in canonical phase
+    order — ROADMAP item 4's "where did the millisecond go" live.
+    Empty when no host exports train_phase_* summaries."""
+    with_phases = [r for r in rows if r.get("phases")]
+    if not with_phases:
+        return []
+    seen = {p for r in with_phases for p in r["phases"]}
+    cols = [p for p in _PHASE_ORDER if p in seen]
+    cols += sorted(seen - set(cols))
+    lines = ["| Host (phase p50 ms) | " + " | ".join(cols)
+             + " | coverage |",
+             "|---" * (len(cols) + 2) + "|"]
+    for r in with_phases:
+        vals = " | ".join(_f(r["phases"].get(c), 3) for c in cols)
+        lines.append(f"| {r['endpoint']} | {vals} "
+                     f"| {_f(r.get('phase_coverage'), 2)} |")
+    return lines
 
 
 def main(argv=None) -> int:

@@ -5,6 +5,11 @@ as Chrome trace-event JSON and critical-path breakdowns.
 Usage:
   python tools/trace_report.py <telemetry_dir | run_dir> [run_dir...]
       [--chrome trace.json] [--limit N]
+  python tools/trace_report.py --merge <run_dir>... --chrome out.json
+      # one Chrome/Perfetto trace for a multi-process cohort
+      # (per-run process_name/pid metadata, wall-clock alignment +
+      # monotonic-clock offset note) — telemetry_report.py --merge
+      # applied to traces
 
 Reads the run's `events.jsonl` (the `kind="span"` records the tracer
 emits) and produces:
@@ -69,20 +74,58 @@ def load_spans(run_dirs: Sequence[str]
 # ---------------------------------------------------------------------
 
 def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
-                                               List[Dict[str, Any]]]]
-                        ) -> List[Dict[str, Any]]:
+                                               List[Dict[str, Any]]]],
+                        merge: bool = False) -> List[Dict[str, Any]]:
     """Spans -> Chrome trace events. ts/dur are microseconds relative
     to the earliest span across all runs (the tracer's monotonic `t0`
     is only meaningful within a process; cross-run alignment uses each
     run's own base — good enough for same-process run sets, which is
-    what a traced run directory holds)."""
+    what a traced run directory holds).
+
+    `merge` (ISSUE 15: `--merge <run_dir>...`, the telemetry_report
+    --merge shape applied to traces) renders a multi-PROCESS cohort as
+    ONE trace: each run keeps its manifest process_index as the Chrome
+    pid (collisions fall back to a fresh id), gets a `process_name`
+    metadata row (run_id + component), and its timeline is offset onto
+    a shared wall clock via the manifest's `created_unix`. Monotonic
+    clocks are per-process, so cross-process alignment is only as good
+    as host wall-clock sync plus the manifest-write-to-first-span
+    latency (~ms) — each process carries a `clock_note` instant event
+    saying exactly that, so nobody reads a 2 ms cross-host gap as
+    truth."""
     events: List[Dict[str, Any]] = []
     flow_id = 0
+    used_pids: Dict[int, int] = {}
+    wall = [m.get("created_unix") for m, s in loaded if s]
+    wall0 = min((w for w in wall if w is not None), default=None)
     for run_idx, (manifest, spans) in enumerate(loaded):
         if not spans:
             continue
         pid = int(manifest.get("process_index", run_idx))
+        if merge:
+            while pid in used_pids:  # two runs claiming one index
+                pid += 1000
+            used_pids[pid] = run_idx
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"p{manifest.get('process_index', '?')}"
+                                 f" {manifest.get('run_id', '?')}"
+                                 f" ({manifest.get('component', '?')})"}})
         base = min(float(s["t0"]) for s in spans)
+        offset_us = 0.0
+        if merge and wall0 is not None \
+                and manifest.get("created_unix") is not None:
+            offset_us = (float(manifest["created_unix"]) - wall0) * 1e6
+        if merge:
+            events.append({
+                "name": "clock_note", "cat": "meta", "ph": "I",
+                "s": "p", "pid": pid, "tid": 0,
+                "ts": round(offset_us, 3),
+                "args": {"note": "timeline offset from manifest "
+                                 "created_unix (monotonic clocks are "
+                                 "per-process): cross-process skew = "
+                                 "host wall-clock sync + manifest-to-"
+                                 "first-span latency"}})
         by_id: Dict[str, Dict[str, Any]] = {s["span"]: s for s in spans}
         seen_threads: Dict[int, str] = {}
         for s in spans:
@@ -93,7 +136,7 @@ def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
                 events.append({"name": "thread_name", "ph": "M",
                                "pid": pid, "tid": tid,
                                "args": {"name": tname}})
-            ts = (float(s["t0"]) - base) * 1e6
+            ts = (float(s["t0"]) - base) * 1e6 + offset_us
             dur = max(float(s.get("dur_ms", 0.0)) * 1e3, 1.0)
             args = {"trace": s.get("trace"), "span": s.get("span")}
             if s.get("parent"):
@@ -110,7 +153,7 @@ def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
                 if src is None:
                     continue
                 flow_id += 1
-                src_ts = (float(src["t0"]) - base) * 1e6
+                src_ts = (float(src["t0"]) - base) * 1e6 + offset_us
                 src_dur = max(float(src.get("dur_ms", 0.0)) * 1e3, 1.0)
                 # bind inside the source slice: at the flow target's
                 # start when that falls within it, else at the edge
@@ -126,10 +169,12 @@ def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
     return events
 
 
-def write_chrome_trace(run_dirs: Sequence[str], out_path: str) -> int:
+def write_chrome_trace(run_dirs: Sequence[str], out_path: str,
+                       merge: bool = False) -> int:
     """Write the Chrome trace JSON for the given run dirs; returns the
-    number of trace events written."""
-    events = chrome_trace_events(load_spans(run_dirs))
+    number of trace events written. `merge` = one cohort trace (see
+    chrome_trace_events)."""
+    events = chrome_trace_events(load_spans(run_dirs), merge=merge)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
@@ -341,9 +386,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chrome", default=None,
                     help="also write Chrome trace-event JSON here "
                          "(Perfetto / chrome://tracing)")
+    ap.add_argument("--merge", action="store_true",
+                    help="treat the given run dirs as ONE multi-"
+                         "process cohort and write a single Chrome "
+                         "trace: per-run process_name/pid metadata, "
+                         "timelines aligned on the manifests' "
+                         "created_unix wall clock (each process "
+                         "carries a clock_note event about the "
+                         "monotonic-offset caveat). Requires --chrome.")
     ap.add_argument("--limit", type=int, default=10,
                     help="per-request rows to print before eliding")
     args = ap.parse_args(argv)
+    if args.merge and not args.chrome:
+        print("error: --merge produces a merged Chrome trace; pass "
+              "--chrome <out.json>", file=sys.stderr)
+        return 2
     run_dirs: List[str] = []
     for p in args.paths:
         found = find_runs(p)
@@ -354,8 +411,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_dirs.extend(found)
     loaded = load_spans(run_dirs)
     if args.chrome:
-        n = write_chrome_trace(run_dirs, args.chrome)
-        print(f"chrome trace: {n} events -> {args.chrome}")
+        n = write_chrome_trace(run_dirs, args.chrome,
+                               merge=args.merge)
+        print(f"chrome trace: {n} events -> {args.chrome}"
+              + (f" (merged cohort of {len(run_dirs)} runs)"
+                 if args.merge else ""))
     sys.stdout.write(render(loaded, limit=args.limit))
     return 0
 
