@@ -234,7 +234,11 @@ class VarMisuseModel:
             alerts_rules=cfg.ALERTS_RULES,
             health_every_s=cfg.HEALTH_EVERY_S, watchdog=watchdog,
             monitors=default_train_monitors(),
-            default_rules=default_train_rules, log=self.log)
+            default_rules=default_train_rules,
+            # identity block on /vars (ISSUE 17), same as jax_model
+            identity={"process_index": jax.process_index(),
+                      "process_count": jax.process_count()},
+            log=self.log)
         alerts = plane.alerts
         self.metrics_server = plane.metrics
         infeed_channel = SpanChannel() if tracer.enabled else None
@@ -321,7 +325,8 @@ class VarMisuseModel:
                     steps_into_training += 1
                     window += batch.num_valid_examples
                     loss_f = (recorder.end_step(self.step_num, loss,
-                                                batch.num_valid_examples)
+                                                batch.num_valid_examples,
+                                                params=self.params)
                               if recorder.enabled else None)
                     if self.step_num % cfg.NUM_BATCHES_TO_LOG_PROGRESS == 0:
                         if loss_f is None:

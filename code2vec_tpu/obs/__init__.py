@@ -14,6 +14,8 @@ from code2vec_tpu.obs.exposition import (LivePlane,  # noqa: F401
                                          MetricsServer,
                                          build_live_plane,
                                          render_prometheus)
+from code2vec_tpu.obs.fleet import (FleetCollector,  # noqa: F401
+                                    fleet_alert_rules)
 from code2vec_tpu.obs.health import HealthEngine  # noqa: F401
 from code2vec_tpu.obs.loop import (TrainStepRecorder,  # noqa: F401
                                    infeed_produce_instrument)

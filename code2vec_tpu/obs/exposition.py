@@ -25,7 +25,22 @@ curl, or tools/obs_top.py:
     log). Serving readiness gates on this.
   - `/vars` — the raw JSON snapshot (registry + health monitor table
     + alert table + watchdog components), for humans and tools that
-    want structure instead of the Prometheus grammar.
+    want structure instead of the Prometheus grammar. ISSUE 17: it
+    leads with an `identity` block (run_id, process_index, cohort
+    size, the server's start wall/monotonic pair) so a fleet
+    collector can label members without parsing JSONL manifests.
+  - `/clock` — the fleet handshake (ISSUE 17): a paired
+    monotonic+wall timestamp sampled at response-build time, plus the
+    identity block. A collector brackets K of these with its own wall
+    clock to estimate this host's wall-clock offset
+    (round-trip-corrected midpoints, median of K), then COMMITS the
+    measurement back (`/clock?commit=1&offset_s=...`): the member
+    writes a `clock` block into its run manifest, which is what
+    `trace_report.py --merge` consumes to align cohort traces on
+    MEASURED offsets instead of the created_unix caveat.
+  - `/fleet` — only when a FleetCollector is attached (the supervisor
+    process): the latest cohort aggregate as JSON, or Prometheus text
+    with `?format=prom`.
 
 Snapshot-don't-lock discipline (ARCHITECTURE.md): handler threads
 never take a lock the hot path contends on — they read dict snapshots
@@ -51,6 +66,7 @@ import json
 import re
 import threading
 import time
+import urllib.parse
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 __all__ = ["LivePlane", "MetricsServer", "build_live_plane",
@@ -163,16 +179,31 @@ class MetricsServer:
     alert tables when attached). Construct via `create()`."""
 
     def __init__(self, telemetry, *, port: int, host: str = "",
-                 watchdog=None, health=None, alerts=None,
+                 watchdog=None, health=None, alerts=None, fleet=None,
+                 identity: Optional[Dict[str, Any]] = None,
                  log: Optional[Callable[[str], None]] = None):
         self.enabled = True
         self.telemetry = telemetry
         self.watchdog = watchdog
         self.health = health
         self.alerts = alerts
+        self.fleet = fleet
         self.port = port
         self.host = host
         self.bound_port: Optional[int] = None
+        # identity block (ISSUE 17): who this endpoint is, stamped at
+        # construction so the wall/monotonic pair anchors the process
+        # start — call sites that know their cohort coordinates (the
+        # train loops, via jax) override process_index/process_count;
+        # this layer never imports jax to ask.
+        self.identity: Dict[str, Any] = {
+            "run_id": getattr(telemetry, "run_id", ""),
+            "process_index": 0,
+            "process_count": 1,
+            "start_wall": time.time(),
+            "start_mono": time.monotonic(),
+        }
+        self.identity.update(identity or {})
         self._log = log or (lambda _m: None)
         self._lock = threading.Lock()
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
@@ -219,6 +250,7 @@ class MetricsServer:
     def _vars(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"ts": time.time(),
                                "run_id": self.telemetry.run_id,
+                               "identity": dict(self.identity),
                                **self.telemetry.summary()}
         out["gauge_age_s"] = {k: round(v, 3) for k, v in
                               self.telemetry.gauge_ages().items()}
@@ -230,8 +262,41 @@ class MetricsServer:
             out["alerts"] = self.alerts.status_table()
         return out
 
+    def _clock(self, params: Dict[str, List[str]]) -> Dict[str, Any]:
+        """The fleet handshake endpoint (ISSUE 17). Plain GET: one
+        paired monotonic+wall sample (the monotonic reading shares the
+        tracer's timebase, so a measured wall offset can realign span
+        t0s) plus the identity block. `?commit=1&offset_s=X`: the
+        collector's measured offset comes BACK — persist it, with a
+        fresh anchor pair, as the run manifest's `clock` block so
+        trace_report --merge can align this run's monotonic timeline
+        onto the collector's wall clock. Memory registries have no
+        manifest; `committed` reports the truth either way."""
+        out: Dict[str, Any] = {"mono": time.monotonic(),
+                               "wall": time.time(),
+                               "identity": dict(self.identity)}
+        if params.get("commit"):
+            try:
+                offset_s = float(params["offset_s"][0])
+            except (KeyError, IndexError, ValueError):
+                out["committed"] = False
+                out["error"] = "commit needs a numeric offset_s"
+                return out
+            block = {"mono": out["mono"], "wall": out["wall"],
+                     "wall_offset_s": offset_s}
+            try:
+                block["samples"] = int(params["samples"][0])
+            except (KeyError, IndexError, ValueError):
+                pass
+            out["committed"] = bool(
+                getattr(self.telemetry, "update_manifest",
+                        lambda **_kw: False)(clock=block))
+        return out
+
     def _respond(self, path: str) -> tuple:
-        """(status, content_type, payload_bytes) for one GET."""
+        """(status, content_type, payload_bytes) for one GET; `path`
+        may carry a query string."""
+        path, _, query = path.partition("?")
         if path == "/metrics":
             text = render_prometheus(self.telemetry, self.watchdog,
                                      self.health, self.alerts)
@@ -245,8 +310,26 @@ class MetricsServer:
             return (200, "application/json",
                     json.dumps(self._vars(), default=str,
                                indent=1).encode("utf-8"))
+        if path == "/clock":
+            body = self._clock(urllib.parse.parse_qs(query))
+            return (200, "application/json",
+                    json.dumps(body, default=str).encode("utf-8"))
+        if path == "/fleet":
+            fleet = self.fleet
+            if fleet is None or not getattr(fleet, "enabled", False):
+                return (404, "text/plain",
+                        b"no fleet collector attached\n")
+            params = urllib.parse.parse_qs(query)
+            if params.get("format", [""])[0] == "prom":
+                return (200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        fleet.render_prometheus().encode("utf-8"))
+            return (200, "application/json",
+                    json.dumps(fleet.aggregate(), default=str,
+                               indent=1).encode("utf-8"))
         return (404, "text/plain",
-                b"not found (try /metrics, /healthz, /vars)\n")
+                b"not found (try /metrics, /healthz, /vars, /clock"
+                b", /fleet)\n")
 
     # ---- lifecycle ----
     def start(self) -> "MetricsServer":
@@ -259,7 +342,7 @@ class MetricsServer:
                 def do_GET(self):  # noqa: N802 — http.server API
                     try:
                         status, ctype, payload = server._respond(
-                            self.path.split("?", 1)[0])
+                            self.path)
                     except Exception as e:  # noqa: BLE001 — a scrape
                         # must never take the run down with it
                         status, ctype = 500, "text/plain"
@@ -282,7 +365,8 @@ class MetricsServer:
                 target=self._httpd.serve_forever, daemon=True,
                 name="metrics-exposition")
             self._thread.start()
-        self._log(f"metrics: serving /metrics /healthz /vars on "
+        self._log(f"metrics: serving /metrics /healthz /vars /clock"
+                  f"{' /fleet' if self.fleet is not None else ''} on "
                   f"port {self.bound_port}")
         return self
 
@@ -303,6 +387,8 @@ class _NullMetricsServer(MetricsServer):
     def __init__(self):
         self.enabled = False
         self.telemetry = None
+        self.fleet = None
+        self.identity = {}
         self.bound_port = None
 
     def start(self):
@@ -338,6 +424,7 @@ def build_live_plane(telemetry, *, metrics_port: int, alerts_mode: str,
                      alerts_rules: Optional[str],
                      health_every_s: float, watchdog, monitors,
                      default_rules: Callable[[], list],
+                     identity: Optional[Dict[str, Any]] = None,
                      log: Optional[Callable[[str], None]] = None
                      ) -> LivePlane:
     """ONE wiring for the live metrics plane, shared by both train
@@ -366,5 +453,5 @@ def build_live_plane(telemetry, *, metrics_port: int, alerts_mode: str,
     watchdog.attach(health=health, alerts=alerts)
     metrics = MetricsServer.create(
         telemetry, port=metrics_port, watchdog=watchdog,
-        health=health, alerts=alerts, log=log)
+        health=health, alerts=alerts, identity=identity, log=log)
     return LivePlane(health, alerts, metrics)

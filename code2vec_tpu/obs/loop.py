@@ -146,10 +146,17 @@ class TrainStepRecorder:
             self._t_yield = now
             yield item
 
-    def end_step(self, step: int, loss, n_examples: int) -> float:
+    def end_step(self, step: int, loss, n_examples: int,
+                 params=None) -> float:
         """Close the current step: sync on the loss transfer, record the
         step/infeed timers, write the per-step event. Returns the loss
-        as a float so the loop's log line reuses the one transfer."""
+        as a float so the loop's log line reuses the one transfer.
+
+        `params` (optional, the live param pytree) feeds the fleet
+        plane's divergence check: every `gauge_every` steps a sampled
+        fingerprint (sum of one sliver per leaf) publishes as a gauge
+        pair, step-labeled so the cohort collector compares hosts at
+        MATCHING steps (obs/fleet.py)."""
         loss_f = float(loss)  # device sync: bounds the dispatched step
         now = time.perf_counter()
         step_ms = (now - self._t_yield) * 1e3
@@ -162,6 +169,9 @@ class TrainStepRecorder:
         # so the non-finite / spike monitors can read it off the hot
         # path (emit=False: a dict store, never a JSONL event)
         tele.gauge("train/loss", loss_f, emit=False)
+        # step label for the loss gauge: SPMD replicas publishing
+        # different losses at the SAME step is runtime divergence
+        tele.gauge("train/loss_step", float(step), emit=False)
         tele.event("step", step=int(step), step_ms=round(step_ms, 3),
                    infeed_wait_ms=round(self._infeed_wait_ms, 3),
                    loss=round(loss_f, 6), examples=int(n_examples))
@@ -175,7 +185,44 @@ class TrainStepRecorder:
         self._steps += 1
         if self._steps % self._gauge_every == 0:
             self._device_memory_gauges()
+            if params is not None:
+                self._params_digest_gauges(step, params)
         return loss_f
+
+    def _params_digest_gauges(self, step: int, params) -> None:
+        """Sampled params fingerprint for the cohort divergence check:
+        one sliver (`leaf[..., :1]`) per leaf, summed in float32 — a
+        few hundred elements instead of the full model, cheap enough
+        for the gauge cadence while still moving when ANY layer's
+        leading column drifts. Replicated-SPMD hosts must agree on it
+        bit-for-bit-ish; the fleet collector compares hosts at the
+        step this pair labels.
+
+        The math MUST stay process-local: an op over a multi-process
+        global array lowers to a collective, and a telemetry-path
+        collective interleaving with the step's gradient all-reduce
+        desyncs the cohort (Gloo aborts on the size mismatch). So
+        only fully-replicated leaves contribute — every host skips
+        the same sharded leaves, so digests stay comparable — and
+        each is read through its LOCAL shard, never the global
+        view."""
+        try:
+            import jax.numpy as jnp
+            total = 0.0
+            import jax
+            for leaf in jax.tree_util.tree_leaves(params):
+                if hasattr(leaf, "is_fully_replicated"):
+                    if not leaf.is_fully_replicated:
+                        continue
+                    leaf = leaf.addressable_data(0)
+                probe = leaf if getattr(leaf, "ndim", 0) == 0 \
+                    else leaf[..., :1]
+                total += float(jnp.sum(probe.astype(jnp.float32)))
+        except Exception:  # non-array pytree / backend quirk: skip
+            return
+        self._tele.gauge("train/params_digest", total, emit=False)
+        self._tele.gauge("train/params_digest_step", float(step),
+                         emit=False)
 
     def _trace_step(self, step: int, step_ms: float,
                     n_examples: int) -> None:

@@ -393,6 +393,34 @@ class Telemetry:
             for s in self.sinks:
                 s.write(ev)
 
+    def update_manifest(self, **fields: Any) -> bool:
+        """Merge `fields` into this run's `manifest.json` (tmp-write +
+        rename, so readers never see a torn file). The fleet handshake
+        (obs/exposition `/clock?commit=1`) persists the MEASURED
+        wall-clock offset this way, which is what trace_report --merge
+        aligns cohort traces with. False = nothing durable to update
+        (memory registry, or the manifest is unreadable) — callers
+        treat that as "this member can't be clock-committed", not an
+        error."""
+        if not self.run_dir:
+            return False
+        path = os.path.join(self.run_dir, "manifest.json")
+        with self._guard():
+            try:
+                with open(path, encoding="utf-8") as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                return False
+            manifest.update(fields)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(manifest, f, indent=2, default=str)
+                os.replace(tmp, path)
+            except OSError:
+                return False
+        return True
+
     # ---- lifecycle ----
     def summary(self) -> Dict[str, Any]:
         with self._guard():

@@ -75,6 +75,7 @@ def supervisor_alert_rules():
     supervisor publishes gauges, these rules turn them into
     edge-triggered `alert` events + stdout lines."""
     from code2vec_tpu.obs.alerts import AlertRule
+    from code2vec_tpu.obs.fleet import fleet_alert_rules
     return [
         AlertRule("train_process_restarted",
                   metric="supervisor/restarts", op=">=", value=1,
@@ -94,6 +95,12 @@ def supervisor_alert_rules():
         AlertRule("restart_budget_exhausted",
                   metric="supervisor/budget_exhausted", op=">=",
                   value=1, severity="page"),
+        # fleet plane (ISSUE 17): the cohort collector publishes its
+        # gauges into THIS registry, so its straggler/divergence
+        # tickets ride the same engine. Installed unconditionally —
+        # threshold rules stay quiet while the fleet/* series are
+        # absent (fleet plane off).
+        *fleet_alert_rules(),
     ]
 
 
@@ -174,6 +181,23 @@ class Supervisor:
         if watchdog is not None and getattr(watchdog, "enabled", False):
             watchdog.attach(cohort=self.cohort_topology)
             self._watchdog_hb = watchdog.register("supervisor_loop")
+        # fleet plane (ISSUE 17): None until attach_fleet — one None
+        # check per site is the whole disabled-path cost
+        self.fleet = None
+        self._fleet_members: List[str] = []
+
+    def attach_fleet(self, collector,
+                     member_urls: Sequence[str]) -> None:
+        """Host the cohort collector (obs/fleet.py) in the supervisor:
+        its gauges land in this registry, its straggler/divergence
+        tickets ride `self.alerts`, its members re-point per attempt
+        (an elastic resize shrinks the scrape set with the mesh), and
+        its cohort snapshot joins stall dumps next to
+        `cohort_topology` (which reads it live)."""
+        if collector is None or not collector.enabled:
+            return
+        self.fleet = collector.attach(alerts=self.alerts)
+        self._fleet_members = list(member_urls)
 
     def cohort_topology(self) -> dict:
         """The live cohort, as a stall-dump-attachable snapshot:
@@ -181,7 +205,7 @@ class Supervisor:
         Read from other threads (the watchdog's dump path) — every
         field is rebuilt per call, nothing is mutated."""
         procs = list(self._procs)
-        return {
+        topo = {
             "target_procs": self.num_procs,
             "cohort_size": self.cur_procs,
             "min_procs": self.min_procs,
@@ -191,6 +215,12 @@ class Supervisor:
             "resizes": [list(r) for r in self.resizes],
             "full_relaunches": self.full_relaunches,
         }
+        if self.fleet is not None:
+            # a wedged cohort's stall dump answers "who was slow"
+            # from the latest fleet sweep, right next to who was in
+            # the mesh
+            topo["fleet"] = self.fleet.brief()
+        return topo
 
     # ---- checkpoint verification (runs before EVERY launch) ----
     def verify_checkpoint(self) -> Optional[int]:
@@ -249,6 +279,11 @@ class Supervisor:
         n = self.cur_procs
         port = free_port() if n > 1 else 0
         self.last_launch_ts = time.time()
+        if self.fleet is not None:
+            # this attempt's scrape set: the first n member endpoints
+            # (a shrunk cohort scrapes the shrunk set; relaunched
+            # members re-handshake when their run_id changes)
+            self.fleet.set_members(self._fleet_members[:n])
         procs = [self._spawn_fn(attempt, i, port, n) for i in range(n)]
         self._procs = procs
         deadline = (time.monotonic() + self.attempt_timeout_s
@@ -300,6 +335,15 @@ class Supervisor:
 
     # ---- the supervised run ----
     def run(self) -> int:
+        if self.fleet is not None:
+            self.fleet.start()
+        try:
+            return self._run()
+        finally:
+            if self.fleet is not None:
+                self.fleet.stop()
+
+    def _run(self) -> int:
         self.telemetry.gauge("supervisor/restarts", 0, emit=False)
         self.telemetry.gauge("supervisor/restarts_remaining",
                              self.max_restarts, emit=False)
@@ -393,6 +437,7 @@ class Supervisor:
 def build_cli_spawn(child_cmd: Sequence[str], *, num_procs: int = 1,
                     out_dir: Optional[str] = None,
                     cpu_devices: Optional[int] = None,
+                    metrics_ports: Optional[Sequence[int]] = None,
                     log: Optional[Callable[[str], None]] = None
                     ) -> Callable[[int, int, int, int],
                                   "subprocess.Popen"]:
@@ -405,8 +450,11 @@ def build_cli_spawn(child_cmd: Sequence[str], *, num_procs: int = 1,
     gets no flags at all and runs plain single-process);
     `cpu_devices` pins the CPU harness's virtual device count via
     `parallel/compat.cpu_worker_env`, BEFORE the child's jax import.
-    Child output streams to `attempt<k>.proc<i>.log` under `out_dir`
-    (or inherits the supervisor's stdio)."""
+    `metrics_ports` gives member i a fixed `--metrics_port` (the fleet
+    collector's scrape set must be knowable BEFORE launch, so members
+    can't pick ephemeral ports). Child output streams to
+    `attempt<k>.proc<i>.log` under `out_dir` (or inherits the
+    supervisor's stdio)."""
     child_cmd = list(child_cmd)
 
     def spawn(attempt: int, proc_id: int, port: int,
@@ -417,6 +465,8 @@ def build_cli_spawn(child_cmd: Sequence[str], *, num_procs: int = 1,
             cmd += ["--dist_coordinator", f"127.0.0.1:{port}",
                     "--dist_num_processes", str(n),
                     "--dist_process_id", str(proc_id)]
+        if metrics_ports is not None and proc_id < len(metrics_ports):
+            cmd += ["--metrics_port", str(metrics_ports[proc_id])]
         if cpu_devices is not None:
             from code2vec_tpu.parallel.compat import cpu_worker_env
             env = cpu_worker_env(cpu_devices)

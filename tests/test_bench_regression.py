@@ -188,7 +188,8 @@ def test_multichip_cli_kind_selects_pattern_and_metrics():
     rows = json.loads(r.stdout)
     assert {row["metric"] for row in rows} == {
         "scaling_efficiency", "multi_pc_per_sec",
-        "recovery_steps_lost", "recovery_seconds"}
+        "recovery_steps_lost", "recovery_seconds",
+        "host_skew_ratio"}
 
 
 def test_multichip_recovery_metrics_gate_lower_is_better():
@@ -257,6 +258,30 @@ def test_multichip_default_metrics_include_recovery_gate():
     from tools.bench_regression import MULTICHIP_METRICS
     assert "recovery_steps_lost" in MULTICHIP_METRICS
     assert "recovery_seconds" in MULTICHIP_METRICS
+    assert "host_skew_ratio" in MULTICHIP_METRICS
+
+
+def test_multichip_host_skew_gates_lower_is_better():
+    """ISSUE 17 satellite: the cohort-evenness ratio (worst member
+    step p50 / cohort median) gates with the band flipped into a
+    ceiling — ok/ keeps the latest skew (1.05) inside it, regress/
+    jumps to 1.42 (one straggler host taxing every lock-step
+    all-reduce) and fails even though the recovery pair stays flat."""
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "ok"),
+                   ["host_skew_ratio"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 0
+    assert rows[0]["status"] == "ok" and rows[0]["lower_is_better"]
+
+    rc, rows = run(os.path.join(FIXTURES, "multichip", "regress"),
+                   ["host_skew_ratio", "recovery_steps_lost"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="MULTICHIP_r*.json")
+    assert rc == 1
+    by = {r["metric"]: r for r in rows}
+    assert by["host_skew_ratio"]["status"] == "REGRESSION"
+    assert by["recovery_steps_lost"]["status"] == "ok"
 
 
 def test_multichip_repo_trajectory_accepted():

@@ -515,16 +515,22 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     bad_probes = Finding("retrace-hazard",
                          "code2vec_tpu/training/phase_probes.py",
                          1, "m", "s")
+    # ISSUE 17 satellite: the fleet plane joins the obs/ fence from
+    # day one — a leak or swallowed error in the cohort collector
+    # (the thing that watches everyone else) is a bug to fix, never
+    # debt to grandfather
+    bad_fleet = Finding("resource-leak",
+                        "code2vec_tpu/obs/fleet.py", 1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
     refused = baseline_mod.write(
         [bad, bad_training, bad_ops, bad_parallel, bad_resilience,
          bad_spmd, bad_spmd_par, bad_nondet, bad_nondet_tr,
-         bad_phases, bad_probes, ok],
+         bad_phases, bad_probes, bad_fleet, ok],
         path)
     assert refused == [bad, bad_training, bad_ops, bad_parallel,
                        bad_resilience, bad_spmd, bad_spmd_par,
                        bad_nondet, bad_nondet_tr, bad_phases,
-                       bad_probes]
+                       bad_probes, bad_fleet]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 

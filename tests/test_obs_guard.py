@@ -170,6 +170,78 @@ def test_live_plane_serves_and_evaluates_without_jax_or_tf(tmp_path):
     assert "LIVE-PLANE-OK" in r.stdout
 
 
+def test_fleet_collector_runs_without_jax_or_tf(tmp_path):
+    """ISSUE 17 extension of the blocked-import pattern: the fleet
+    plane — member exposition with /clock + identity, the cohort
+    collector's handshake / scrape / straggler math — must import AND
+    run (real HTTP round-trips included) with BOTH jax and tensorflow
+    import-blocked. The collector runs on laptops and supervisors;
+    obs/ stays a pure-stdlib layer."""
+    code = textwrap.dedent("""
+        import json, sys, threading, urllib.request
+        import code2vec_tpu.obs as obs
+        from code2vec_tpu.obs.fleet import FleetCollector
+
+        # disabled path first: no members -> the shared no-op
+        # singleton, and not one thread started
+        before = len(threading.enumerate())
+        off = FleetCollector.create(obs.Telemetry.memory("sup"),
+                                    members=())
+        off.start(); off.sample(); off.stop()
+        assert not off.enabled and off.aggregate() == {}
+        assert len(threading.enumerate()) == before
+
+        # one real member endpoint (memory registry + exposition)
+        m = obs.Telemetry.memory("member").make_threadsafe()
+        m.count("train/steps", 4)
+        m.count("train/examples", 128)
+        m.gauge("train/max_contexts", 8, emit=False)
+        m.record_ms("train/step_ms", 100.0)
+        srv = obs.MetricsServer(
+            m, port=0,
+            identity={"run_id": "r-guard", "process_index": 0,
+                      "process_count": 1}).start()
+        ep = f"127.0.0.1:{srv.bound_port}"
+
+        # /clock serves paired readings + identity
+        c = json.load(urllib.request.urlopen(
+            f"http://{ep}/clock", timeout=5))
+        assert "mono" in c and "wall" in c
+        assert c["identity"]["run_id"] == "r-guard"
+
+        # supervisor-side collector: real handshake + scrape over HTTP
+        sup = obs.Telemetry.memory("sup").make_threadsafe()
+        fc = FleetCollector.create(sup, members=[ep],
+                                   handshake_samples=3)
+        agg = fc.sample()
+        row = agg["hosts"][0]
+        assert row["up"] and row["run_id"] == "r-guard"
+        assert row["step_p50"] == 100.0
+        assert row["clock_offset_s"] is not None
+        assert agg["cohort"]["hosts_up"] == 1
+        # /fleet serves the aggregate when a collector is attached
+        fsrv = obs.MetricsServer(sup, port=0, fleet=fc).start()
+        out = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{fsrv.bound_port}/fleet", timeout=5))
+        assert out["cohort"]["hosts_up"] == 1
+        prom = urllib.request.urlopen(
+            f"http://127.0.0.1:{fsrv.bound_port}/fleet?format=prom",
+            timeout=5).read().decode()
+        assert "fleet_hosts_up 1.0" in prom
+        fc.stop(); fsrv.stop(); srv.stop()
+
+        assert "jax" not in sys.modules
+        assert "tensorflow" not in sys.modules
+        print("FLEET-GUARD-OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code],
+                       env=_tf_blocked_env(tmp_path, block_jax=True),
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FLEET-GUARD-OK" in r.stdout
+
+
 def test_tier1_collection_is_tf_free(tmp_path):
     """`pytest --collect-only` over the tier-1 selection with TF
     blocked: any test module importing TensorFlow at module scope

@@ -71,9 +71,14 @@ DEFAULT_METRICS = ("value", "int8_pc_per_sec", "transformer_pc_per_sec",
 # could mask (both legs regressing together). The kill-mid-run leg
 # (ISSUE 13) adds the recovery-cost pair — gated LOWER-is-better: a
 # re-form that loses more steps or takes longer to reach its first
-# post-resize step is the regression.
+# post-resize step is the regression. host_skew_ratio (ISSUE 17) is
+# the cohort-evenness gate: worst member step p50 over the cohort
+# median — a straggler host taxes every step through the lock-step
+# all-reduce, and the ratio catches it even when the summed
+# throughput still squeaks past its floor.
 MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
-                     "recovery_steps_lost", "recovery_seconds")
+                     "recovery_steps_lost", "recovery_seconds",
+                     "host_skew_ratio")
 
 # Metrics where SMALLER is healthier: the band becomes a ceiling
 # (baseline * (1 + band)) instead of a floor. Everything else in the
@@ -81,7 +86,8 @@ MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
 # direction-agnostic. Any phase_*_ms key rides the same direction via
 # _lower_is_better (per-phase device times are costs, not throughput).
 LOWER_IS_BETTER = frozenset({"recovery_steps_lost",
-                             "recovery_seconds"})
+                             "recovery_seconds",
+                             "host_skew_ratio"})
 
 
 def _lower_is_better(metric: str) -> bool:

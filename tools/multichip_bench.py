@@ -360,6 +360,15 @@ def build_result(base: dict, multi: dict, args_ns) -> dict:
         if multi["ms_per_step_p50"] > 0 else float("nan")
     eff_mean = multi["pc_per_sec"] / base["pc_per_sec"] \
         if base["pc_per_sec"] > 0 else float("nan")
+    # per-host step-time skew (ISSUE 17): worst member p50 over the
+    # cohort median p50 — the offline twin of the fleet plane's live
+    # `fleet/step_p50_skew`. 1.0 = perfectly even hosts; a straggler
+    # inflates it and the lock-step all-reduce makes everyone pay, so
+    # bench_regression gates it LOWER-is-better.
+    member_p50 = [r["ms_per_step_p50"] for r in multi["per_process"]]
+    med = _percentile(member_p50, 50)
+    skew = max(member_p50) / med \
+        if member_p50 and med > 0 else float("nan")
     return {
         "schema": "multichip",
         "sparse": bool(args_ns.sparse),
@@ -379,6 +388,7 @@ def build_result(base: dict, multi: dict, args_ns) -> dict:
         / multi["n_devices"],
         "scaling_efficiency": eff,
         "scaling_efficiency_mean": eff_mean,
+        "host_skew_ratio": skew,
         "loss_delta": abs(multi["final_loss"] - base["final_loss"]),
         "baseline": base,
         "multi": multi,

@@ -23,23 +23,42 @@ negative steps/s. path-contexts/s = examples-rate × the
 firing alerts, stalled components and stale gauges (age > --stale_s)
 come straight off the same scrape; hosts running --phase_profile
 additionally get a per-phase p50 column set (ISSUE 15). Pure stdlib
-(urllib + re) — runs on a laptop against a pod with nothing
-installed.
+(urllib + the shared obs/promtext parser, itself re-only) — runs on a
+laptop against a pod with nothing installed beyond this checkout.
+
+`--fleet <url>` (ISSUE 17) switches the source: instead of scraping N
+raw endpoints and differencing counters here, poll the supervisor-side
+fleet collector's `/fleet` aggregate — per-host rows plus the cohort
+signals only the collector can compute (straggler score with phase
+attribution, loss/params divergence, measured clock offsets).
 """
 
 from __future__ import annotations
 
 import argparse
-import re
+import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
-_LINE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
-_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# ONE exposition parser + counter-reset discipline for every scrape
+# consumer (ISSUE 17 hoist): obs_top grew the original; the shared
+# module now owns it and the fleet collector imports the same one.
+# Re-exported names keep the historical `from tools.obs_top import
+# parse_prometheus` imports working.
+from code2vec_tpu.obs.promtext import (CounterRates,  # noqa: E402
+                                       labeled, parse_prometheus,
+                                       scalar)
+
+__all__ = ["EndpointState", "labeled", "main", "parse_prometheus",
+           "render", "render_fleet", "render_phases", "scalar",
+           "scrape"]
 
 # canonical phase-column order: code2vec_tpu/obs/phases.py PHASE_ORDER
 # plus the trailing fused_step timer (kept literal here so this tool
@@ -50,43 +69,6 @@ _PHASE_ORDER = ("infeed_wait", "embed_gather", "concat_dense",
                 "forward_pool", "backward", "table_apply",
                 "backward_apply", "allreduce", "allreduce_exposed",
                 "fused_step")
-
-
-def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict, float]]]:
-    """Text exposition format -> {metric: [(labels, value), ...]}."""
-    out: Dict[str, List[Tuple[Dict, float]]] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _LINE_RE.match(line)
-        if not m:
-            continue
-        name, labels_raw, raw = m.groups()
-        try:
-            value = float(raw)
-        except ValueError:
-            continue
-        labels = (dict(_LABEL_RE.findall(labels_raw))
-                  if labels_raw else {})
-        out.setdefault(name, []).append((labels, value))
-    return out
-
-
-def scalar(metrics: Dict, name: str) -> Optional[float]:
-    """First unlabeled sample of a family (counters/gauges here carry
-    no labels)."""
-    for labels, value in metrics.get(name, ()):
-        if not labels:
-            return value
-    return None
-
-
-def labeled(metrics: Dict, name: str, **want) -> Optional[float]:
-    for labels, value in metrics.get(name, ()):
-        if all(labels.get(k) == v for k, v in want.items()):
-            return value
-    return None
 
 
 def scrape(endpoint: str, timeout_s: float = 3.0) -> Dict:
@@ -102,7 +84,10 @@ class EndpointState:
 
     def __init__(self, endpoint: str):
         self.endpoint = endpoint
-        self.last: Optional[Tuple[float, Dict]] = None  # (t, metrics)
+        # the shared counter-reset discipline (obs/promtext): a counter
+        # going BACKWARD annotates the row RESTARTED and rates clamp to
+        # the new process's progress instead of negative steps/s
+        self.rates = CounterRates()
         self.error: Optional[str] = None
 
     def poll(self, stale_s: float) -> Optional[Dict[str, Any]]:
@@ -116,28 +101,7 @@ class EndpointState:
         except (urllib.error.URLError, OSError, ValueError) as e:
             self.error = str(getattr(e, "reason", e))
             return {"endpoint": self.endpoint, "error": self.error}
-        prev, self.last = self.last, (t, metrics)
-        restarted: List[str] = []
-
-        def rate(counter: str) -> Optional[float]:
-            cur = scalar(metrics, counter)
-            if prev is None or cur is None:
-                return None
-            old = scalar(prev[1], counter)
-            dt = t - prev[0]
-            if old is None or dt <= 0:
-                return None
-            if cur < old:
-                # per-host counter reset: a supervisor restart or
-                # elastic resize replaced the process, zeroing its
-                # cumulative counters — the raw difference is negative
-                # garbage. Annotate the row and rate what the NEW
-                # process accumulated this window (cur since its zero),
-                # clamped >= 0, instead of rendering negative steps/s.
-                restarted.append(counter)
-                return max(0.0, cur) / dt
-            return (cur - old) / dt
-
+        rate = self.rates.advance(t, metrics)
         ex_rate = rate("train_examples")
         max_ctx = scalar(metrics, "train_max_contexts")
         stalled = [labels.get("component", "?")
@@ -183,7 +147,7 @@ class EndpointState:
             "alerts": firing,
             "unhealthy": unhealthy,
             "stale_gauges": stale,
-            "restarted": restarted,
+            "restarted": self.rates.restarted,
             "phases": phases,
             "phase_coverage": scalar(metrics, "health_phase_coverage"),
         }
@@ -271,12 +235,82 @@ def render_phases(rows: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def fetch_fleet(url: str, timeout_s: float = 3.0) -> Dict[str, Any]:
+    """One `/fleet` aggregate off the supervisor-side collector."""
+    base = url if "://" in url else f"http://{url}"
+    base = base.rstrip("/")
+    if not base.endswith("/fleet"):
+        base += "/fleet"
+    with urllib.request.urlopen(base, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def render_fleet(agg: Dict[str, Any]) -> str:
+    """One frame off the fleet aggregate: cohort headline (summed
+    throughput, straggler verdict with its attributed series,
+    divergence), then per-host rows with measured clock offsets —
+    the collector already did the differencing and the cross-host
+    math, so this renders, it does not derive."""
+    cohort = agg.get("cohort") or {}
+    hosts = agg.get("hosts") or []
+    lines: List[str] = []
+    strag = cohort.get("straggler_score")
+    strag_bit = "—"
+    if strag is not None:
+        strag_bit = f"{strag:.2f}x"
+        if cohort.get("straggler_host"):
+            strag_bit += (f" ({cohort['straggler_host']} via "
+                          f"{cohort.get('straggler_series')})")
+    div = "DIVERGED" if cohort.get("divergence") else "converged"
+    lines.append(
+        f"obs_top --fleet — {cohort.get('hosts_up', 0)}"
+        f"/{cohort.get('hosts_total', 0)} hosts up | "
+        f"pc/s (sum) {_f(cohort.get('pc_per_sec'))} | "
+        f"straggler {strag_bit} | {div} | "
+        f"clock spread {_f((cohort.get('clock_spread_s') or 0) * 1e3, 3)} ms | "
+        f"{time.strftime('%H:%M:%S')}")
+    lines.append("| Host | steps | ex/s | pc/s | step p50 ms "
+                 "| infeed p50 ms | loss | straggler | clock off ms "
+                 "| status |")
+    lines.append("|---" * 10 + "|")
+    for r in hosts:
+        if not r.get("up"):
+            lines.append(f"| {r['endpoint']} | DOWN: "
+                         f"{r.get('error')} | | | | | | | | |")
+            continue
+        bits = []
+        if r.get("restarted"):
+            bits.append("RESTARTED")
+        score = r.get("straggler_score")
+        score_bit = "—"
+        if score is not None:
+            score_bit = f"{score:.2f}x {r.get('straggler_series')}"
+        off = r.get("clock_offset_s")
+        lines.append(
+            f"| {r['endpoint']} | {_f(r.get('steps'), 0)} "
+            f"| {_f(r.get('ex_s'))} | {_f(r.get('pc_s'))} "
+            f"| {_f(r.get('step_p50'), 2)} "
+            f"| {_f(r.get('infeed_p50'), 2)} "
+            f"| {_f(r.get('loss'), 4)} | {score_bit} "
+            f"| {_f(off * 1e3 if off is not None else None, 3)} "
+            f"| {' '.join(bits) if bits else 'ok'} |")
+    phase_lines = render_phases(hosts)
+    if phase_lines:
+        lines.append("")
+        lines.extend(phase_lines)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="live multi-host view over /metrics endpoints")
-    ap.add_argument("endpoints", nargs="+",
+    ap.add_argument("endpoints", nargs="*",
                     help="host:port (or full URL) of each "
                          "--metrics_port exposition server")
+    ap.add_argument("--fleet", default=None, metavar="URL",
+                    help="poll the supervisor-side fleet collector's "
+                         "/fleet aggregate instead of raw endpoints "
+                         "(ISSUE 17)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval in seconds")
     ap.add_argument("--once", action="store_true",
@@ -287,6 +321,31 @@ def main(argv=None) -> int:
     ap.add_argument("--stale_s", type=float, default=60.0,
                     help="mark gauges older than this as stale")
     args = ap.parse_args(argv)
+    if args.fleet is None and not args.endpoints:
+        ap.error("give /metrics endpoints, or --fleet <url>")
+
+    if args.fleet is not None:
+        # aggregate mode: the collector differenced and derived; poll
+        # and render its latest sweep (no warm-up frame needed)
+        n = 0
+        try:
+            while True:
+                try:
+                    out = render_fleet(fetch_fleet(args.fleet))
+                except (urllib.error.URLError, OSError,
+                        ValueError) as e:
+                    out = (f"obs_top --fleet — {args.fleet} DOWN: "
+                           f"{getattr(e, 'reason', e)}")
+                if not args.once and n:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(out)
+                n += 1
+                if args.once or (args.count and n >= args.count):
+                    return 0
+                time.sleep(max(args.interval, 0.05))
+        except KeyboardInterrupt:
+            return 0
+
     states = [EndpointState(e) for e in args.endpoints]
 
     def frame() -> List[Dict[str, Any]]:

@@ -7,9 +7,10 @@ Usage:
       [--chrome trace.json] [--limit N]
   python tools/trace_report.py --merge <run_dir>... --chrome out.json
       # one Chrome/Perfetto trace for a multi-process cohort
-      # (per-run process_name/pid metadata, wall-clock alignment +
-      # monotonic-clock offset note) — telemetry_report.py --merge
-      # applied to traces
+      # (per-run process_name/pid metadata; aligned on the fleet
+      # handshake's MEASURED clock offsets when every manifest has a
+      # `clock` block, else the created_unix fallback + clock_note
+      # caveat) — telemetry_report.py --merge applied to traces
 
 Reads the run's `events.jsonl` (the `kind="span"` records the tracer
 emits) and produces:
@@ -87,36 +88,73 @@ def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
     ONE trace: each run keeps its manifest process_index as the Chrome
     pid (collisions fall back to a fresh id), gets a `process_name`
     metadata row (run_id + component), and its timeline is offset onto
-    a shared wall clock via the manifest's `created_unix`. Monotonic
-    clocks are per-process, so cross-process alignment is only as good
-    as host wall-clock sync plus the manifest-write-to-first-span
-    latency (~ms) — each process carries a `clock_note` instant event
-    saying exactly that, so nobody reads a 2 ms cross-host gap as
-    truth."""
+    a shared wall clock.
+
+    Alignment comes in two qualities. When EVERY run's manifest
+    carries the `clock` block the fleet handshake commits (ISSUE 17:
+    paired monotonic+wall readings plus the collector-MEASURED
+    wall-clock offset, obs/fleet.py), span timelines convert from the
+    tracer's monotonic timebase to the collector's wall clock exactly:
+    `t0 - clock.mono` re-bases the span onto the paired reading, `+
+    clock.wall - clock.wall_offset_s` lands it on the collector's
+    clock — cross-process gaps are then real to handshake precision
+    (sub-ms on a LAN) and the old caveat is retired. Without measured
+    clocks the pre-17 fallback applies: offset by the manifests'
+    `created_unix`, only as good as host wall sync + manifest-to-
+    first-span latency, and each process carries a `clock_note`
+    instant event saying exactly that, so nobody reads a 2 ms
+    cross-host gap as truth."""
     events: List[Dict[str, Any]] = []
     flow_id = 0
     used_pids: Dict[int, int] = {}
-    wall = [m.get("created_unix") for m, s in loaded if s]
-    wall0 = min((w for w in wall if w is not None), default=None)
+    # measured path: every run with spans must carry a handshake clock
+    # block — a half-measured cohort would interleave exact and sloppy
+    # timelines as if they were comparable
+    clocks = [m.get("clock") for m, s in loaded if s]
+    measured = bool(clocks) and all(
+        isinstance(c, dict)
+        and all(k in c for k in ("mono", "wall", "wall_offset_s"))
+        for c in clocks)
+    if merge and measured:
+        corrected = []
+        for manifest, spans in loaded:
+            if not spans:
+                continue
+            c = manifest["clock"]
+            base = min(float(s["t0"]) for s in spans)
+            corrected.append(base - float(c["mono"]) + float(c["wall"])
+                             - float(c["wall_offset_s"]))
+        wall0 = min(corrected, default=None)
+    else:
+        wall = [m.get("created_unix") for m, s in loaded if s]
+        wall0 = min((w for w in wall if w is not None), default=None)
     for run_idx, (manifest, spans) in enumerate(loaded):
         if not spans:
             continue
         pid = int(manifest.get("process_index", run_idx))
+        base = min(float(s["t0"]) for s in spans)
+        offset_us = 0.0
+        if merge and measured and wall0 is not None:
+            c = manifest["clock"]
+            offset_us = (base - float(c["mono"]) + float(c["wall"])
+                         - float(c["wall_offset_s"]) - wall0) * 1e6
+        elif merge and wall0 is not None \
+                and manifest.get("created_unix") is not None:
+            offset_us = (float(manifest["created_unix"]) - wall0) * 1e6
         if merge:
             while pid in used_pids:  # two runs claiming one index
                 pid += 1000
             used_pids[pid] = run_idx
-            events.append({
-                "name": "process_name", "ph": "M", "pid": pid,
-                "args": {"name": f"p{manifest.get('process_index', '?')}"
-                                 f" {manifest.get('run_id', '?')}"
-                                 f" ({manifest.get('component', '?')})"}})
-        base = min(float(s["t0"]) for s in spans)
-        offset_us = 0.0
-        if merge and wall0 is not None \
-                and manifest.get("created_unix") is not None:
-            offset_us = (float(manifest["created_unix"]) - wall0) * 1e6
-        if merge:
+            name_args: Dict[str, Any] = {
+                "name": f"p{manifest.get('process_index', '?')}"
+                        f" {manifest.get('run_id', '?')}"
+                        f" ({manifest.get('component', '?')})"}
+            if measured:
+                name_args["clock_offset_s"] = float(
+                    manifest["clock"]["wall_offset_s"])
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "args": name_args})
+        if merge and not measured:
             events.append({
                 "name": "clock_note", "cat": "meta", "ph": "I",
                 "s": "p", "pid": pid, "tid": 0,
@@ -125,7 +163,9 @@ def chrome_trace_events(loaded: Sequence[Tuple[Dict[str, Any],
                                  "created_unix (monotonic clocks are "
                                  "per-process): cross-process skew = "
                                  "host wall-clock sync + manifest-to-"
-                                 "first-span latency"}})
+                                 "first-span latency; run under the "
+                                 "fleet plane (ISSUE 17) to commit "
+                                 "MEASURED offsets instead"}})
         by_id: Dict[str, Dict[str, Any]] = {s["span"]: s for s in spans}
         seen_threads: Dict[int, str] = {}
         for s in spans:
@@ -390,10 +430,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="treat the given run dirs as ONE multi-"
                          "process cohort and write a single Chrome "
                          "trace: per-run process_name/pid metadata, "
-                         "timelines aligned on the manifests' "
-                         "created_unix wall clock (each process "
-                         "carries a clock_note event about the "
-                         "monotonic-offset caveat). Requires --chrome.")
+                         "timelines aligned on the fleet handshake's "
+                         "measured clock offsets when every manifest "
+                         "carries one (ISSUE 17), else on "
+                         "created_unix with a clock_note caveat "
+                         "event. Requires --chrome.")
     ap.add_argument("--limit", type=int, default=10,
                     help="per-request rows to print before eliding")
     args = ap.parse_args(argv)

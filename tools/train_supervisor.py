@@ -24,7 +24,15 @@ Everything after `--` is the child command. The supervisor:
     corrupt step dirs (training/checkpoint.verify_and_resolve) so the
     child resumes from the last VERIFIED committed step;
   - escalates through the alert engine (`--telemetry_dir` makes the
-    `alert` / `supervisor_*` events durable JSONL).
+    `alert` / `supervisor_*` events durable JSONL);
+  - hosts the fleet plane (ISSUE 17) behind `--fleet_port`: each
+    member gets a fixed `--metrics_port` (base `--member_metrics_base`
+    + process index), the supervisor-side collector scrapes them all,
+    runs the clock handshake (members persist measured offsets into
+    their run manifests for `trace_report --merge`), publishes
+    cohort straggler/divergence/throughput gauges, and serves the
+    aggregate on `http://localhost:<fleet_port>/fleet` (JSON;
+    `?format=prom` for Prometheus text).
 
 Exit codes: 0 = the supervised run completed; 3 = restart budget
 exhausted; 2 = usage error.
@@ -89,6 +97,16 @@ def main(argv=None) -> int:
                     help="per-attempt child logs "
                          "(attempt<k>.proc<i>.log); default: inherit "
                          "stdio")
+    ap.add_argument("--fleet_port", type=int, default=None,
+                    help="host the cohort fleet collector (ISSUE 17) "
+                         "and serve /fleet on this port (0 = any "
+                         "free port); members get fixed "
+                         "--metrics_port flags")
+    ap.add_argument("--member_metrics_base", type=int, default=9200,
+                    help="member i serves /metrics on base+i (the "
+                         "fleet collector's scrape set)")
+    ap.add_argument("--fleet_interval_s", type=float, default=2.0,
+                    help="fleet collector sweep interval")
     ap.add_argument("child", nargs=argparse.REMAINDER,
                     help="-- <child command>")
     args = ap.parse_args(argv)
@@ -124,10 +142,16 @@ def main(argv=None) -> int:
                                    stall_s=args.watchdog_stall_s,
                                    log=log).start()
 
+    member_ports = None
+    if args.fleet_port is not None:
+        member_ports = [args.member_metrics_base + i
+                        for i in range(args.procs)]
+
     sup = Supervisor(
         build_cli_spawn(child, num_procs=args.procs,
                         out_dir=args.out_dir,
-                        cpu_devices=args.cpu_devices, log=log),
+                        cpu_devices=args.cpu_devices,
+                        metrics_ports=member_ports, log=log),
         num_procs=args.procs, max_restarts=args.max_restarts,
         resize_policy=args.resize_policy, min_procs=args.min_procs,
         ckpt_dir=save_dir, telemetry=telemetry, watchdog=watchdog,
@@ -137,12 +161,32 @@ def main(argv=None) -> int:
         backoff=RetryPolicy("supervisor-restart", max_attempts=1,
                             base_delay_s=args.backoff_base_s,
                             max_delay_s=60.0))
+    fleet_server = None
+    if member_ports is not None:
+        from code2vec_tpu.obs import FleetCollector, MetricsServer
+        members = [f"127.0.0.1:{p}" for p in member_ports]
+        collector = FleetCollector.create(
+            sup.telemetry, members=members,
+            interval_s=args.fleet_interval_s, log=log)
+        sup.attach_fleet(collector, members)
+        # the supervisor's own /metrics (+ /fleet) endpoint: the
+        # collector's fleet/* gauges live in sup.telemetry, so one
+        # scrape of this port sees both the supervisor and the cohort
+        port = args.fleet_port
+        if port == 0:
+            from code2vec_tpu.parallel.compat import free_port
+            port = free_port()
+        fleet_server = MetricsServer.create(
+            sup.telemetry, port=port, fleet=collector,
+            log=log).start()
     try:
         rc = sup.run()
     except RestartBudgetExceeded as e:
         log(str(e))
         rc = 3
     finally:
+        if fleet_server is not None:
+            fleet_server.stop()
         if watchdog is not None:
             watchdog.stop()
         if telemetry is not None:
