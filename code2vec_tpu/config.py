@@ -217,6 +217,30 @@ class Config:
     # never a fresh pool spawn per request.
     SERVE_EXTRACT_WORKERS: int = 2
 
+    # ---- external serving plane (ISSUE 18, serving/frontend.py +
+    # replicas.py + reload.py + autoscale.py): HTTP front-end over a
+    # replica fleet with hot weight reload and SLO autoscaling. ----
+    # HTTP front-end port (POST /predict, GET /healthz /metrics
+    # /pool). 0 = no socket (the in-process surface still works).
+    SERVE_PORT: int = 0
+    # Initial replica count: N PredictionServers (one model each)
+    # behind one shared prediction cache.
+    SERVE_REPLICAS: int = 1
+    # Autoscaler bounds: the pool never shrinks below min or grows
+    # past max, whatever the SLO rules say.
+    SERVE_MIN_REPLICAS: int = 1
+    SERVE_MAX_REPLICAS: int = 4
+    # p99 latency SLO in ms: the autoscaler's serving_p99_slo alert
+    # rule threshold (serve/request_ms:p99 > slo -> grow the pool).
+    SERVE_SLO_MS: float = 250.0
+    # Checkpoint-dir poll cadence for hot weight reload: committed
+    # steps are sha256-verified then rolled one replica at a time.
+    # 0 = reload off.
+    SERVE_RELOAD_POLL_S: float = 0.0
+    # Run the SLO autoscaling policy loop (off = fixed-size pool;
+    # death/refill still applies either way).
+    SERVE_AUTOSCALE: bool = False
+
     # ---- encoder architecture: "bag" (reference parity) or
     # "transformer" (set transformer over the contexts,
     # models/transformer_encoder.py; BASELINE.json configs[4]). ----
@@ -670,6 +694,40 @@ class Config:
                        dest="serve_extract_workers", type=int,
                        default=None,
                        help="persistent extractor worker pool size")
+        p.add_argument("--serve_port", dest="serve_port", type=int,
+                       default=None,
+                       help="HTTP front-end port (POST /predict, GET "
+                            "/healthz /metrics /pool); 0 = no socket")
+        p.add_argument("--serve_replicas", dest="serve_replicas",
+                       type=int, default=None,
+                       help="initial replica count behind the serving "
+                            "front-end (one model per replica, one "
+                            "shared prediction cache)")
+        p.add_argument("--serve_min_replicas",
+                       dest="serve_min_replicas", type=int,
+                       default=None,
+                       help="autoscaler floor: the pool never shrinks "
+                            "below this")
+        p.add_argument("--serve_max_replicas",
+                       dest="serve_max_replicas", type=int,
+                       default=None,
+                       help="autoscaler ceiling: the pool never grows "
+                            "past this")
+        p.add_argument("--serve_slo_ms", dest="serve_slo_ms",
+                       type=float, default=None,
+                       help="p99 latency SLO in ms (the autoscaler's "
+                            "serving_p99_slo rule threshold)")
+        p.add_argument("--serve_reload_poll_s",
+                       dest="serve_reload_poll_s", type=float,
+                       default=None,
+                       help="checkpoint-dir poll cadence for hot "
+                            "weight reload (sha256-verified, one "
+                            "replica at a time); 0 = off")
+        p.add_argument("--serve_autoscale", dest="serve_autoscale",
+                       action="store_true",
+                       help="run the SLO autoscaling policy loop "
+                            "(grow on burn-rate/p99 pages, shrink "
+                            "after a sustained quiet window)")
         p.add_argument("--faults", dest="faults", default=None,
                        help="deterministic fault injection: a JSON "
                             "file (or inline JSON) arming named "
@@ -837,6 +895,20 @@ class Config:
             cfg.SERVE_CACHE_SIZE = ns.serve_cache_size
         if ns.serve_extract_workers is not None:
             cfg.SERVE_EXTRACT_WORKERS = ns.serve_extract_workers
+        if ns.serve_port is not None:
+            cfg.SERVE_PORT = ns.serve_port
+        if ns.serve_replicas is not None:
+            cfg.SERVE_REPLICAS = ns.serve_replicas
+        if ns.serve_min_replicas is not None:
+            cfg.SERVE_MIN_REPLICAS = ns.serve_min_replicas
+        if ns.serve_max_replicas is not None:
+            cfg.SERVE_MAX_REPLICAS = ns.serve_max_replicas
+        if ns.serve_slo_ms is not None:
+            cfg.SERVE_SLO_MS = ns.serve_slo_ms
+        if ns.serve_reload_poll_s is not None:
+            cfg.SERVE_RELOAD_POLL_S = ns.serve_reload_poll_s
+        if ns.serve_autoscale:
+            cfg.SERVE_AUTOSCALE = True
         if ns.faults is not None:
             cfg.FAULTS = ns.faults
         if ns.attack is not None:
@@ -961,6 +1033,27 @@ class Config:
             raise ValueError("--serve_cache_size must be >= 0.")
         if self.SERVE_EXTRACT_WORKERS < 1:
             raise ValueError("--serve_extract_workers must be >= 1.")
+        if not 0 <= self.SERVE_PORT <= 65535:
+            raise ValueError("--serve_port must be in [0, 65535].")
+        if self.SERVE_MIN_REPLICAS < 1:
+            raise ValueError("--serve_min_replicas must be >= 1.")
+        if self.SERVE_MAX_REPLICAS < self.SERVE_MIN_REPLICAS:
+            raise ValueError(
+                "--serve_max_replicas must be >= --serve_min_replicas "
+                f"(got {self.SERVE_MAX_REPLICAS} < "
+                f"{self.SERVE_MIN_REPLICAS}).")
+        if not (self.SERVE_MIN_REPLICAS <= self.SERVE_REPLICAS
+                <= self.SERVE_MAX_REPLICAS):
+            raise ValueError(
+                "--serve_replicas must sit inside "
+                "[--serve_min_replicas, --serve_max_replicas] "
+                f"(got {self.SERVE_REPLICAS} outside "
+                f"[{self.SERVE_MIN_REPLICAS}, "
+                f"{self.SERVE_MAX_REPLICAS}]).")
+        if self.SERVE_SLO_MS <= 0:
+            raise ValueError("--serve_slo_ms must be > 0.")
+        if self.SERVE_RELOAD_POLL_S < 0:
+            raise ValueError("--serve_reload_poll_s must be >= 0.")
         if self.TRACE and not self.TELEMETRY_DIR:
             raise ValueError(
                 "--trace requires --telemetry_dir (spans are recorded "

@@ -750,6 +750,14 @@ class Code2VecModel(Code2VecModelBase):
                           for n in [1 << i for i in range(
                               max(1, max_batch).bit_length())]
                           + [max(1, max_batch)]})
+        # Commit the params to their current placement BEFORE the
+        # warmup compiles: a hot weight swap restores COMMITTED arrays
+        # (orbax restores to explicit shardings), and jit keys on
+        # committedness — warming up against uncommitted init params
+        # would make every post-swap batch a recompile.
+        self.params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, x.sharding)
+            if hasattr(x, "sharding") else x, self.params)
         for b in buckets:
             batch = (np.zeros((b,), np.int32),
                      np.zeros((b, self.dims.max_contexts), np.int32),

@@ -52,7 +52,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["AlertError", "AlertRule", "AlertEngine", "load_rules",
-           "default_train_rules", "default_serving_rules"]
+           "default_train_rules", "default_serving_rules",
+           "serving_slo_rules"]
 
 _OPS = {
     ">": lambda a, b: a > b,
@@ -248,6 +249,32 @@ def default_serving_rules() -> List[AlertRule]:
                   kind="burn_rate",
                   denominator="serve/requests+serve/shed",
                   op=">", value=0.05, windows=(60.0, 300.0)),
+    ]
+
+
+def serving_slo_rules(slo_ms: float = 250.0, *,
+                      windows: Sequence[float] = (30.0, 120.0)
+                      ) -> List[AlertRule]:
+    """The external-serving SLO rule set (ISSUE 18): what the
+    autoscaler's policy loop scales on, and what the serving front-end
+    reports. The p99 rule and the shed burn-rate are the two
+    page-severity signals the pool grows on; `reload_refused` and
+    `replica_dead` are ticket-severity — operator-visible facts that
+    the system already self-healed (refused the corrupt step, refilled
+    the dead replica), not pages."""
+    return [
+        AlertRule("serving_p99_slo", metric="serve/request_ms:p99",
+                  op=">", value=float(slo_ms), for_s=5.0),
+        # same denominator discipline as default_serving_rules: divide
+        # by ALL submissions or a total-shed outage silences the alert
+        AlertRule("serving_shed_burn", metric="serve/shed",
+                  kind="burn_rate",
+                  denominator="serve/requests+serve/shed",
+                  op=">", value=0.05, windows=windows),
+        AlertRule("reload_refused", metric="serve/reload_refused",
+                  op=">=", value=1.0, severity="ticket"),
+        AlertRule("replica_dead", metric="serve/replica_dead",
+                  op=">=", value=1.0, severity="ticket"),
     ]
 
 

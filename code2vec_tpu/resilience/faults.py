@@ -26,6 +26,11 @@ Sites wired through the codebase:
                    `kill`: the SIGKILL a replica pool must absorb;
                    ROADMAP item 1's serving-chaos hook, symmetric
                    with serve/extract)
+  reload/read      serving/reload.ReloadManager — IO failure while
+                   reading a VERIFIED checkpoint's weights for a hot
+                   swap (`io_error`: exercises the reload retry
+                   policy; exhausted retries refuse the step, the
+                   pool keeps serving the weights it has)
   dist/init        parallel/distributed.maybe_initialize — transient
                    Gloo/coordination-service connect failure
 

@@ -1,0 +1,277 @@
+"""Hot weight reload from committed checkpoints (ISSUE 18 tentpole).
+
+The Check-N-Run side of the serving plane: a watcher polls the
+checkpoint dir for committed steps (the `step_<N>/state` rename is the
+commit point — exactly what `training/checkpoint._step_dirs` counts),
+verifies each candidate against its `checksums.json` sidecar, and rolls
+verified weights across the `ReplicaPool` one replica at a time
+(`pool.swap_params`, generation = step). The discipline is
+commit-or-refuse:
+
+  - sha256 mismatch / missing file / unreadable manifest -> the step is
+    REFUSED: `serve/reload_refused` counter, a `reload_refused` event,
+    and (when an alert engine is attached) an immediate sweep so the
+    ticket-severity `reload_refused` rule fires. The step lands in a
+    refused set so one corrupt write doesn't log-spam every poll; the
+    pool keeps serving the weights it has.
+  - checksums not written yet (the trainer dies — or is simply slow —
+    in the rename->sidecar window) -> no verdict this sweep; the step
+    is re-examined next poll instead of being served unverified.
+  - IO errors while READING verified weights retry under the shared
+    `RetryPolicy` shape (`reload-io`), with the `reload/read` failpoint
+    inside the retried window so chaos runs exercise exactly the
+    production path; exhausted retries refuse the step (reason "io")
+    rather than crashing the serving plane.
+
+Checksum verification is reimplemented here over the same manifest
+format rather than imported: `training/checkpoint.py` imports jax at
+module scope, and the serving control plane must import (and be guard-
+tested) with jax blocked. Loading the weights themselves DOES need jax
+— the default `load_fn` late-imports the checkpoint module only when a
+verified step is actually swapped in; tests inject a stdlib `load_fn`.
+
+`ReloadManager.create()` follows the disabled-singleton discipline:
+poll_s <= 0 or no checkpoint dir returns a shared no-op.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+from code2vec_tpu.obs import Telemetry
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.resilience.retry import RetryPolicy
+
+__all__ = ["ReloadManager", "committed_steps", "verify_step_files",
+           "CHECKSUMS_NAME"]
+
+# the committed-checkpoint layout contract (training/checkpoint.py owns
+# the write side; this module only ever reads)
+_STEP_RE = re.compile(r"^step_(\d+)$")
+CHECKSUMS_NAME = "checksums.json"
+
+
+def committed_steps(ckpt_dir: str):
+    """Sorted [(step, step_dir)] of COMMITTED steps only — a torn save
+    (temp dir present, no renamed `state`) is invisible, the same rule
+    `checkpoint._step_dirs` applies on the restore side."""
+    out = []
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(os.path.join(ckpt_dir, name,
+                                                 "state")):
+                out.append((int(m.group(1)),
+                            os.path.join(ckpt_dir, name)))
+    return sorted(out)
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_step_files(ckpt_dir: str, step: int) -> Optional[bool]:
+    """`checkpoint.verify_step`'s tri-state, stdlib-only: True = every
+    state file matches its recorded sha256 (and no file is missing or
+    extra); False = corrupt; None = no checksums manifest yet."""
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    manifest_path = os.path.join(step_dir, CHECKSUMS_NAME)
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            recorded = json.load(f)["files"]
+    except (OSError, ValueError, KeyError):
+        return False  # an unreadable integrity manifest IS corruption
+    state_dir = os.path.join(step_dir, "state")
+    actual = {}
+    for base, _dirs, files in os.walk(state_dir):
+        for name in files:
+            p = os.path.join(base, name)
+            rel = os.path.relpath(p, step_dir).replace(os.sep, "/")
+            actual[rel] = _hash_file(p)
+    if set(actual) != set(recorded):
+        return False
+    return all(actual[k] == v.get("sha256")
+               for k, v in recorded.items())
+
+
+class ReloadManager:
+    """Watch a checkpoint dir, verify, swap. One instance per pool.
+
+    `load_fn(step) -> params` is injectable; the default late-imports
+    `training/checkpoint` and restores against the pool's live param
+    template (verify=False there — THIS manager already verified, and
+    a second full-tree hash per swap would double reload IO).
+    """
+
+    def __init__(self, ckpt_dir: str, pool, *,
+                 load_fn: Optional[Callable[[int], object]] = None,
+                 telemetry: Telemetry = None, alerts=None,
+                 poll_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 retry: Optional[RetryPolicy] = None, log=None):
+        self.enabled = True
+        self.ckpt_dir = ckpt_dir
+        self.pool = pool
+        self._load_fn = load_fn
+        tele = telemetry if telemetry is not None \
+            else getattr(pool, "telemetry", None)
+        self.telemetry = tele if tele is not None \
+            else Telemetry.disabled()
+        self.alerts = alerts
+        self.poll_s = poll_s
+        self._clock = clock
+        self._log = log or (lambda *a, **k: None)
+        self.retry = retry if retry is not None else RetryPolicy(
+            "reload-io", max_attempts=3, base_delay_s=0.05,
+            max_delay_s=1.0, retry_on=(OSError,),
+            log=self._log)
+        # start from the present: steps already on disk at construction
+        # are the weights the pool booted from, not news
+        steps = committed_steps(ckpt_dir)
+        self.last_step = steps[-1][0] if steps else -1
+        self.refused: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---- construction ----
+    @classmethod
+    def create(cls, ckpt_dir: Optional[str], pool, *,
+               poll_s: float = 0.0, **kw) -> "ReloadManager":
+        if not ckpt_dir or poll_s <= 0:
+            return _NULL_RELOAD
+        return cls(ckpt_dir, pool, poll_s=poll_s, **kw)
+
+    @classmethod
+    def disabled(cls) -> "ReloadManager":
+        return _NULL_RELOAD
+
+    # ---- the sweep ----
+    def check_now(self) -> Optional[int]:
+        """One watcher sweep. Returns the step swapped in, or None
+        (nothing new / refused / verdict pending)."""
+        steps = committed_steps(self.ckpt_dir)
+        if not steps:
+            return None
+        step = steps[-1][0]
+        if step <= self.last_step or step in self.refused:
+            return None
+        verdict = verify_step_files(self.ckpt_dir, step)
+        if verdict is None:
+            # committed state, no checksums yet: the trainer is inside
+            # the rename->sidecar window (or died there). Wait — a
+            # serving plane never swaps unverified weights.
+            return None
+        if verdict is False:
+            self._refuse(step, reason="checksum_mismatch")
+            return None
+        try:
+            params = self.retry.call(self._read_params, step)
+        except OSError as e:
+            self._refuse(step, reason="io", error=repr(e))
+            return None
+        self.pool.swap_params(params, generation=step)
+        self.last_step = step
+        self.telemetry.count("serve/reloads")
+        self.telemetry.gauge("serve/reload_step", step, emit=False)
+        self.telemetry.event("weights_reloaded", step=step)
+        self._log(f"reload: step {step} verified and swapped in")
+        return step
+
+    def _read_params(self, step: int):
+        # inside the retry window AND before any bytes move: chaos
+        # `reload/read` io_error specs exercise the retry policy on
+        # exactly the path production IO errors take
+        faults.fire("reload/read", step=step, path=self.ckpt_dir)
+        if self._load_fn is not None:
+            return self._load_fn(step)
+        import code2vec_tpu.training.checkpoint as ckpt
+        template = self.pool.params_template()
+        restored = ckpt.load_checkpoint(self.ckpt_dir,
+                                        {"params": template},
+                                        step=step, verify=False)
+        return restored["params"]
+
+    def _refuse(self, step: int, reason: str, **fields) -> None:
+        self.refused.add(step)
+        self.telemetry.count("serve/reload_refused")
+        self.telemetry.event("reload_refused", step=step,
+                             reason=reason, **fields)
+        self._log(f"reload REFUSED step {step}: {reason}")
+        if self.alerts is not None:
+            # sweep immediately so the ticket-severity rule transitions
+            # on the refusal, not up to a poll period later
+            self.alerts.check_now()
+
+    # ---- polling thread ----
+    def start(self) -> "ReloadManager":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="weight-reload",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_now()
+            except Exception as e:
+                # the watcher must outlive a bad sweep (transient FS
+                # weirdness, a pool mid-close); refusals and retries
+                # are handled above — this is the backstop
+                self._log(f"reload sweep failed: {e!r}")
+                self.telemetry.count("serve/reload_sweep_errors")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30.0)
+
+    def status(self) -> dict:
+        return {"last_step": self.last_step,
+                "refused": sorted(self.refused),
+                "poll_s": self.poll_s}
+
+
+class _NullReloadManager(ReloadManager):
+    """Reload off: the shared no-op singleton."""
+
+    def __init__(self):
+        self.enabled = False
+        self.ckpt_dir = None
+        self.pool = None
+        self.telemetry = Telemetry.disabled()
+        self.alerts = None
+        self.poll_s = 0.0
+        self.last_step = -1
+        self.refused = set()
+        self._thread = None
+
+    def check_now(self):
+        return None
+
+    def start(self):
+        return self
+
+    def stop(self) -> None:
+        pass
+
+    def status(self) -> dict:
+        return {"last_step": -1, "refused": [], "poll_s": 0.0}
+
+
+_NULL_RELOAD = _NullReloadManager()

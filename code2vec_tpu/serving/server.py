@@ -72,30 +72,54 @@ def normalize_bag(line: str) -> Tuple[str, Tuple[str, ...]]:
 class PredictionCache:
     """Thread-safe LRU over normalized path-context bags. Values are the
     finished `MethodPredictionResults` — a hit skips parse, encode and
-    the device round-trip entirely."""
+    the device round-trip entirely.
+
+    Generations (ISSUE 18): when a ReplicaPool shares ONE cache across
+    replicas, a hot weight swap must invalidate atomically — clear +
+    bump happen under the same lock, and `get`/`put` carrying a stale
+    `generation` are refused, so a mid-roll replica still running old
+    params can neither read new-generation entries nor write old-params
+    results back. Callers that never pass `generation` (the
+    single-server path) are unaffected: None matches any generation."""
 
     def __init__(self, capacity: int):
         self.capacity = capacity
+        self.generation = 0
         self._lock = threading.Lock()
         self._d: "collections.OrderedDict" = collections.OrderedDict()
 
-    def get(self, key) -> Optional[MethodPredictionResults]:
+    def get(self, key, generation: Optional[int] = None
+            ) -> Optional[MethodPredictionResults]:
         if self.capacity <= 0:
             return None
         with self._lock:
+            if generation is not None and generation != self.generation:
+                return None
             val = self._d.get(key)
             if val is not None:
                 self._d.move_to_end(key)
             return val
 
-    def put(self, key, value: MethodPredictionResults) -> None:
+    def put(self, key, value: MethodPredictionResults,
+            generation: Optional[int] = None) -> None:
         if self.capacity <= 0:
             return
         with self._lock:
+            if generation is not None and generation != self.generation:
+                return
             self._d[key] = value
             self._d.move_to_end(key)
             while len(self._d) > self.capacity:
                 self._d.popitem(last=False)
+
+    def invalidate(self, generation: int) -> None:
+        """Drop every entry and advance to `generation` in one critical
+        section — the atomic swap barrier. Concurrent readers see either
+        (old entries, old generation) or (empty, new generation), never
+        a mix."""
+        with self._lock:
+            self._d.clear()
+            self.generation = generation
 
     def __len__(self) -> int:
         with self._lock:
@@ -108,7 +132,8 @@ class PredictionServer:
     client of this; `tools/loadgen.py` drives it at target QPS."""
 
     def __init__(self, config: Config, model, telemetry: Telemetry = None,
-                 tracer: Tracer = None, watchdog: Watchdog = None):
+                 tracer: Tracer = None, watchdog: Watchdog = None,
+                 cache=None):
         self.config = config
         self.model = model
         tele = telemetry if telemetry is not None \
@@ -156,7 +181,10 @@ class PredictionServer:
         self.health = self._live_plane.health
         self.alerts = self._live_plane.alerts
         self.metrics_server = self._live_plane.metrics
-        self.cache = PredictionCache(config.SERVE_CACHE_SIZE)
+        # per-instance by default; a ReplicaPool injects a shared
+        # generation-scoped view so N replicas hit ONE cache (ISSUE 18)
+        self.cache = cache if cache is not None \
+            else PredictionCache(config.SERVE_CACHE_SIZE)
         self.batcher = MicroBatcher(
             self._run_batch, max_batch=config.SERVE_BATCH_MAX,
             timeout_ms=config.SERVE_BATCH_TIMEOUT_MS,
@@ -455,9 +483,12 @@ class PredictionServer:
         `enqueued_at` (same monotonic clock as the tracer). The span
         contexts were handed off BY the client threads; this thread
         only starts spans of its own, never ends theirs."""
-        from code2vec_tpu.models.jax_model import PreparedRows
         self._batcher_hb.busy()
-        prepared = PreparedRows.concat([r.rows for r in requests])
+        # duck-typed through the rows' own class (PreparedRows.concat
+        # in production): the batch path must not import jax — the
+        # serving plane is guard-tested with jax blocked on fake models
+        prepared = type(requests[0].rows).concat(
+            [r.rows for r in requests])
         flush_span = None
         if self.tracer.enabled:
             now = self.tracer.clock()

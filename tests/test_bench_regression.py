@@ -329,3 +329,60 @@ def test_bench_r06_with_phase_breakdown_passes_real_trajectory(
     # phase keys: no prior history -> skipped this round, gated from
     # the first round with 2+ phase-bearing predecessors
     assert by["phase_backward_ms"]["status"] == "skip"
+
+
+# ---- --kind serving: the SERVING_r*.json trajectory (ISSUE 18)
+
+
+def test_serving_ok_trajectory_passes():
+    """serving_p99_ms gates as a CEILING (lower-is-better) and
+    serving_req_per_sec as the usual floor; the ok/ trajectory keeps
+    the latest round inside both bands."""
+    rc, rows = run(os.path.join(FIXTURES, "serving", "ok"),
+                   ["serving_p99_ms", "serving_req_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="SERVING_r*.json")
+    assert rc == 0
+    by = {r["metric"]: r for r in rows}
+    assert by["serving_p99_ms"]["status"] == "ok"
+    assert by["serving_p99_ms"]["lower_is_better"]
+    assert by["serving_req_per_sec"]["status"] == "ok"
+    assert not by["serving_req_per_sec"]["lower_is_better"]
+
+
+def test_serving_regression_fails_both_directions():
+    """regress/ blows the p99 ceiling (19.5 vs a ~7.3 baseline) AND
+    drops throughput below the floor — both read REGRESSION, each in
+    its own direction."""
+    rc, rows = run(os.path.join(FIXTURES, "serving", "regress"),
+                   ["serving_p99_ms", "serving_req_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="SERVING_r*.json")
+    assert rc == 1
+    by = {r["metric"]: r for r in rows}
+    assert by["serving_p99_ms"]["status"] == "REGRESSION"
+    assert by["serving_req_per_sec"]["status"] == "REGRESSION"
+
+
+def test_serving_cli_kind_selects_pattern_and_metrics():
+    r = subprocess.run(
+        [sys.executable, "tools/bench_regression.py", "--kind",
+         "serving", "--dir",
+         os.path.join(FIXTURES, "serving", "regress"), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout + r.stderr
+    rows = json.loads(r.stdout)
+    assert {row["metric"] for row in rows} == {
+        "serving_p99_ms", "serving_req_per_sec"}
+
+
+def test_serving_repo_trajectory_accepted():
+    """The repo-root SERVING history must never crash the gate: with
+    a single captured round there is no baseline yet (skip / rc 0);
+    as rounds accrue it becomes a real gate. No false REGRESSION
+    either way."""
+    rc, rows = run(REPO, ["serving_p99_ms", "serving_req_per_sec"],
+                   band=0.05, window=5, min_history=2, strict=False,
+                   pattern="SERVING_r*.json")
+    assert rc in (0, 2)
+    assert all(r["status"] != "REGRESSION" for r in rows)

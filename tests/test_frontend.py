@@ -1,0 +1,665 @@
+"""External serving plane (ISSUE 18): HTTP front-end, replica pool,
+hot weight reload, SLO autoscaling — tier-1, on fake models (no jax on
+the test path; the guard test at the bottom runs the whole plane in a
+subprocess with jax IMPORT-BLOCKED). The real-model integration legs
+live in tools/serving_bench.py and tools/chaos.py serve_swap_kill."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import pytest
+
+from code2vec_tpu.common import MethodPredictionResults
+from code2vec_tpu.config import Config
+from code2vec_tpu.obs import Telemetry
+from code2vec_tpu.obs.alerts import AlertRule, serving_slo_rules
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.resilience.retry import RetryPolicy
+from code2vec_tpu.serving import (AutoScaler, PredictionCache,
+                                  ReloadManager, ReplicaPool,
+                                  ServerOverloaded, ServingFrontend)
+from code2vec_tpu.serving.frontend import serialize_prediction
+from code2vec_tpu.serving.reload import (committed_steps,
+                                         verify_step_files)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- fakes: the model surface PredictionServer/ReplicaPool drive ----
+
+class FakePrepared:
+    """PreparedRows' surface: n / slice / concat over raw lines."""
+
+    def __init__(self, lines):
+        self.lines = list(lines)
+
+    @property
+    def n(self):
+        return len(self.lines)
+
+    def slice(self, a, b):
+        return FakePrepared(self.lines[a:b])
+
+    @classmethod
+    def concat(cls, parts):
+        out = []
+        for p in parts:
+            out.extend(p.lines)
+        return cls(out)
+
+
+class FakeModel:
+    """Predicts `pred|<tag>` for every line, where tag lives in
+    `params` — so a hot swap visibly changes the answers and a stale
+    cache read is detectable."""
+
+    def __init__(self, tag="v0"):
+        self.params = {"tag": tag}
+        self.warmups = 0
+
+    def warmup_predict(self, max_batch):
+        self.warmups += 1
+        return [max_batch]
+
+    def predict_compile_count(self):
+        return 2  # flat after warmup: compile_delta must read 0
+
+    def prepare_predict_rows(self, lines):
+        for ln in lines:
+            if ln.startswith("!"):
+                raise ValueError(f"malformed line: {ln!r}")
+        return FakePrepared(lines)
+
+    def predict_device(self, prepared):
+        return (list(prepared.lines),)
+
+    def decode_predictions(self, chunk, result):
+        out = []
+        for ln in result[0]:
+            res = MethodPredictionResults(ln.split(" ")[0])
+            res.append_prediction("pred|" + self.params["tag"], 0.9)
+            res.append_attention_path(0.5, "src", "1,2,3", "dst")
+            out.append(res)
+        return out
+
+
+def fake_config(**kw):
+    cfg = Config(SERVE_BATCH_MAX=8, SERVE_BATCH_TIMEOUT_MS=1.0,
+                 SERVE_QUEUE_DEPTH=32, SERVE_DEADLINE_MS=0.0,
+                 SERVE_CACHE_SIZE=64, SERVE_REPLICAS=2,
+                 SERVE_MIN_REPLICAS=1, SERVE_MAX_REPLICAS=3)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def make_pool(replicas=2, tag="v0", **cfg_kw):
+    tele = Telemetry.memory("frontend-test").make_threadsafe()
+    pool = ReplicaPool(fake_config(**cfg_kw), lambda: FakeModel(tag),
+                       replicas=replicas, telemetry=tele)
+    return pool.start(), tele
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(url, body: bytes):
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            raw = r.read().decode("utf-8")
+            status = r.status
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode("utf-8")
+        status = e.code
+    try:
+        return status, json.loads(raw)
+    except json.JSONDecodeError:
+        return status, raw
+
+
+# ---- HTTP round trip ----
+
+def test_http_predict_healthz_metrics_pool_round_trip():
+    pool, tele = make_pool()
+    fe = ServingFrontend(pool, port=0, telemetry=tele).start()
+    base = f"http://127.0.0.1:{fe.bound_port}"
+    try:
+        status, body = _post(base + "/predict", json.dumps(
+            {"lines": ["methodA a,1,b", "methodB c,2,d"]}).encode())
+        assert status == 200 and body["n"] == 2
+        first = body["predictions"][0]
+        assert first["original_name"] == "methodA"
+        assert first["predictions"][0]["name"] == ["pred", "v0"]
+        assert first["predictions"][0]["probability"] == 0.9
+        assert first["attention_paths"][0]["source_token"] == "src"
+        assert "code_vector" not in first  # stays out of the wire shape
+
+        status, raw = _get(base + "/healthz")
+        health = json.loads(raw)
+        assert status == 200 and health["status"] == "ok"
+        assert health["ready"] == 2
+
+        status, raw = _get(base + "/pool")
+        table = json.loads(raw)
+        assert status == 200 and table["size"] == 2
+        assert [r["state"] for r in table["replicas"]] == \
+            ["ready", "ready"]
+
+        status, raw = _get(base + "/metrics")
+        assert status == 200
+        assert b"serve_requests" in raw  # the shared exposition format
+
+        assert _get(base + "/nope")[0] == 404
+    finally:
+        fe.stop()
+        pool.close()
+
+
+def test_http_error_mapping_400_429_500():
+    class StubPool:
+        telemetry = None
+
+        def __init__(self, exc):
+            self.exc = exc
+
+        def predict_lines(self, lines, deadline_ms=None):
+            raise self.exc
+
+        def pool_table(self):
+            return {"replicas": [], "size": 1, "ready": 1, "target": 1,
+                    "generation": 0, "cache_entries": 0,
+                    "cache_generation": 0}
+
+    shed = ServingFrontend(StubPool(ServerOverloaded("queue full")),
+                           port=0).start()
+    bad = ServingFrontend(StubPool(ValueError("bad line")),
+                          port=0).start()
+    boom = ServingFrontend(StubPool(RuntimeError("device fell over")),
+                           port=0).start()
+    payload = json.dumps({"lines": ["m a,1,b"]}).encode()
+    try:
+        base = f"http://127.0.0.1:{shed.bound_port}"
+        status, body = _post(base + "/predict", payload)
+        assert status == 429 and body["shed"] is True
+
+        # malformed request bodies 400 before touching the pool
+        assert _post(base + "/predict", b"{not json")[0] == 400
+        assert _post(base + "/predict",
+                     json.dumps({"lines": "m a,1,b"}).encode())[0] == 400
+        assert _post(base + "/predict", json.dumps(
+            {"lines": ["m"], "deadline_ms": "soon"}).encode())[0] == 400
+        assert _post(base + "/elsewhere", payload)[0] == 404
+
+        status, body = _post(
+            f"http://127.0.0.1:{bad.bound_port}/predict", payload)
+        assert status == 400 and "bad line" in body["error"]
+
+        status, body = _post(
+            f"http://127.0.0.1:{boom.bound_port}/predict", payload)
+        assert status == 500
+    finally:
+        shed.stop()
+        bad.stop()
+        boom.stop()
+
+
+def test_healthz_gates_on_ready_and_page_alerts():
+    class StubAlerts:
+        enabled = True
+
+        def __init__(self, rows):
+            self.rows = rows
+
+        def status_table(self):
+            return self.rows
+
+    pool, tele = make_pool(replicas=1)
+    firing = StubAlerts([{"rule": "serving_p99_slo", "state": "firing",
+                          "severity": "page"}])
+    ticket = StubAlerts([{"rule": "reload_refused", "state": "firing",
+                          "severity": "ticket"}])
+    fe = ServingFrontend(pool, port=0, alerts=ticket).start()
+    try:
+        base = f"http://127.0.0.1:{fe.bound_port}"
+        # a ticket-severity firing rule never fails readiness
+        assert _get(base + "/healthz")[0] == 200
+        fe.alerts = firing
+        status, raw = _get(base + "/healthz")
+        assert status == 503
+        assert json.loads(raw)["alerts_firing"] == ["serving_p99_slo"]
+        fe.alerts = None
+        pool.shrink()  # no-op at min; kill readiness the hard way
+        for rep in list(pool._replicas):
+            pool._stop_replica(rep, state="stopped")
+        assert _get(base + "/healthz")[0] == 503
+    finally:
+        fe.stop()
+        pool.close()
+
+
+def test_disabled_singletons_share_noop_paths():
+    pool, _tele = make_pool(replicas=1)
+    try:
+        assert not ServingFrontend.create(None, port=9).enabled
+        assert not ServingFrontend.create(pool, port=0).enabled
+        assert ServingFrontend.create(pool, port=0).start().bound_port \
+            is None
+        assert not ReloadManager.create(None, pool, poll_s=1.0).enabled
+        assert not ReloadManager.create("/tmp/x", pool,
+                                        poll_s=0.0).enabled
+        assert ReloadManager.disabled().check_now() is None
+        assert not AutoScaler.create(pool, enabled=False).enabled
+        assert AutoScaler.disabled().tick() is None
+    finally:
+        pool.close()
+
+
+# ---- shared generation-scoped cache ----
+
+def test_cache_generation_scoping_and_atomic_invalidate():
+    cache = PredictionCache(4)
+    cache.put("k", "old", generation=0)
+    assert cache.get("k", generation=0) == "old"
+    assert cache.get("k") == "old"  # None matches any generation
+    cache.invalidate(7)
+    assert len(cache) == 0 and cache.generation == 7
+    # a replica still on the old generation is isolated BOTH ways
+    assert cache.get("k", generation=0) is None
+    cache.put("k", "stale-write", generation=0)
+    assert len(cache) == 0
+    cache.put("k", "new", generation=7)
+    assert cache.get("k", generation=7) == "new"
+
+
+def test_swap_invalidates_shared_cache_no_stale_reads():
+    pool, tele = make_pool()
+    try:
+        line = "methodX a,1,b"
+        first = pool.predict_lines([line])[0]
+        assert first.predictions[0]["name"] == ["pred", "v0"]
+        again = pool.predict_lines([line])[0]
+        assert again is first  # served from the shared cache
+        assert tele.counters.get("serve/cache_hit") == 1
+
+        pool.swap_params({"tag": "v1"}, generation=1)
+        table = pool.pool_table()
+        assert table["generation"] == 1
+        assert table["cache_generation"] == 1
+        assert table["cache_entries"] == 0
+        swapped = pool.predict_lines([line])[0]
+        # the OLD cached result must not leak through the swap
+        assert swapped.predictions[0]["name"] == ["pred", "v1"]
+        assert tele.counters.get("serve/cache_hit") == 1  # no new hit
+    finally:
+        pool.close()
+
+
+# ---- rolling swap / death / refill ----
+
+def test_swap_rolls_one_replica_at_a_time_never_below_n_minus_1():
+    pool, tele = make_pool(replicas=3)
+    try:
+        snaps = []
+        orig = pool._publish
+
+        def spy():
+            orig()
+            snaps.append(tele.gauges.get("serve/pool_ready"))
+
+        pool._publish = spy
+        pool.swap_params({"tag": "v2"}, generation=2)
+        assert snaps and min(snaps) >= 2  # never below N-1 of 3
+        table = pool.pool_table()
+        assert table["ready"] == 3 and table["generation"] == 2
+        assert all(r["generation"] == 2 and r["swaps"] == 1
+                   for r in table["replicas"])
+    finally:
+        pool.close()
+
+
+def test_replica_death_retries_request_and_refills():
+    faults.install({"seed": 0, "sites": {
+        "serve/kill": {"action": "raise", "at": 1}}},
+        log=lambda _m: None)
+    pool, tele = make_pool(replicas=2)
+    try:
+        # the first dispatch dies mid-request; the pool must answer
+        # anyway (retry on the survivor) and refill in the background
+        out = pool.predict_lines(["methodY a,1,b"])
+        assert out[0].predictions[0]["name"] == ["pred", "v0"]
+        assert tele.counters.get("serve/replica_dead") == 1
+        assert pool.wait_ready(2, timeout_s=10)
+        assert tele.counters.get("serve/replica_refill") == 1
+        assert pool.compile_delta() == 0  # refill warmup is baseline
+    finally:
+        faults.clear()
+        pool.close()
+
+
+def test_replacement_gate_denial_leaves_pool_smaller():
+    faults.install({"seed": 0, "sites": {
+        "serve/kill": {"action": "raise", "at": 1}}},
+        log=lambda _m: None)
+    tele = Telemetry.memory("gate-test").make_threadsafe()
+    pool = ReplicaPool(fake_config(), lambda: FakeModel(),
+                       replicas=2, telemetry=tele,
+                       replacement_fn=lambda: False).start()
+    try:
+        pool.predict_lines(["methodZ a,1,b"])
+        assert pool.wait_ready(1, timeout_s=10)
+        for t in list(pool._refill_threads):
+            t.join(timeout=10)
+        assert pool.size() == 1  # budget said no: smaller, not wedged
+        assert tele.counters.get("serve/replica_refill") is None
+    finally:
+        faults.clear()
+        pool.close()
+
+
+# ---- hot reload: verify, swap, refuse ----
+
+def _write_step(root, step, payload: bytes, checksums=True):
+    state = root / f"step_{step}" / "state"
+    state.mkdir(parents=True)
+    (state / "params.bin").write_bytes(payload)
+    if checksums:
+        _write_checksums(root, step)
+
+
+def _write_checksums(root, step):
+    payload = (root / f"step_{step}" / "state"
+               / "params.bin").read_bytes()
+    (root / f"step_{step}" / "checksums.json").write_text(json.dumps(
+        {"step": step, "files": {"state/params.bin": {
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "bytes": len(payload)}}}))
+
+
+def test_reload_swaps_verified_and_refuses_corrupt(tmp_path):
+    pool, tele = make_pool()
+    rm = ReloadManager(str(tmp_path), pool,
+                       load_fn=lambda step: {"tag": f"s{step}"},
+                       telemetry=tele, poll_s=0.05)
+    try:
+        assert rm.check_now() is None  # empty dir: nothing to do
+
+        _write_step(tmp_path, 1, b"good weights")
+        assert rm.check_now() == 1
+        assert pool.pool_table()["generation"] == 1
+        out = pool.predict_lines(["methodR a,1,b"])
+        assert out[0].predictions[0]["name"] == ["pred", "s1"]
+
+        # bit-flip the committed blob AFTER its checksums were written:
+        # exactly the corruption the manifest exists to catch
+        _write_step(tmp_path, 2, b"soon to rot")
+        blob = tmp_path / "step_2" / "state" / "params.bin"
+        raw = bytearray(blob.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        blob.write_bytes(bytes(raw))
+        assert verify_step_files(str(tmp_path), 2) is False
+        assert rm.check_now() is None
+        assert rm.refused == {2}
+        assert tele.counters.get("serve/reload_refused") == 1
+        # the pool keeps serving the weights it has
+        assert pool.pool_table()["generation"] == 1
+        # and the refusal does not log-spam: the step stays refused
+        assert rm.check_now() is None
+        assert tele.counters.get("serve/reload_refused") == 1
+
+        # committed but no checksums yet (the rename->sidecar window):
+        # no verdict, re-examined next sweep — never served unverified
+        _write_step(tmp_path, 3, b"still committing", checksums=False)
+        assert verify_step_files(str(tmp_path), 3) is None
+        assert rm.check_now() is None
+        assert 3 not in rm.refused
+        _write_checksums(tmp_path, 3)
+        assert rm.check_now() == 3
+        assert pool.pool_table()["generation"] == 3
+        assert rm.status()["last_step"] == 3
+
+        assert [s for s, _ in committed_steps(str(tmp_path))] == \
+            [1, 2, 3]
+    finally:
+        rm.stop()
+        pool.close()
+
+
+def test_reload_io_errors_retry_then_refuse(tmp_path):
+    pool, tele = make_pool(replicas=1)
+    calls = []
+
+    def flaky_load(step):
+        calls.append(step)
+        raise OSError(5, "transient-looking but persistent")
+
+    rm = ReloadManager(
+        str(tmp_path), pool, load_fn=flaky_load, telemetry=tele,
+        poll_s=0.05,
+        retry=RetryPolicy("reload-io", max_attempts=2,
+                          base_delay_s=0.0, max_delay_s=0.0,
+                          retry_on=(OSError,)))
+    try:
+        _write_step(tmp_path, 1, b"verified but unreadable")
+        assert rm.check_now() is None
+        assert calls == [1, 1]  # the full retry budget was spent
+        assert rm.refused == {1}
+        assert tele.counters.get("serve/reload_refused") == 1
+        assert pool.pool_table()["generation"] == 0
+    finally:
+        rm.stop()
+        pool.close()
+
+
+# ---- autoscaler: up on burn, down after quiet hold ----
+
+def test_autoscale_up_on_page_rule_down_after_hold():
+    pool, tele = make_pool(replicas=1)
+    clk = [0.0]
+    scaler = AutoScaler(
+        pool, telemetry=tele,
+        rules=[AlertRule("hot", metric="load", op=">", value=1.0,
+                         severity="page"),
+               AlertRule("note", metric="load", op=">", value=0.0,
+                         severity="ticket")],
+        hold_s=60.0, clock=lambda: clk[0])
+    try:
+        tele.gauge("load", 5.0, emit=False)
+        assert scaler.tick() == "up" and pool.target == 2
+        clk[0] = 1.0
+        assert scaler.tick() == "up" and pool.target == 3
+        clk[0] = 2.0
+        assert scaler.tick() is None  # at SERVE_MAX_REPLICAS
+        assert tele.counters.get("serve/scale_up") == 2
+
+        # quiet (the page rule resolves; the TICKET rule still firing
+        # must not block the shrink) — held for hold_s, then one down
+        # per quiet window
+        tele.gauge("load", 0.5, emit=False)
+        clk[0] = 10.0
+        assert scaler.tick() is None  # quiet timer arms
+        clk[0] = 69.0
+        assert scaler.tick() is None  # inside the hold
+        clk[0] = 71.0
+        assert scaler.tick() == "down" and pool.target == 2
+        clk[0] = 72.0
+        assert scaler.tick() is None  # window re-armed
+        clk[0] = 135.0
+        assert scaler.tick() == "down" and pool.target == 1
+        clk[0] = 200.0
+        assert scaler.tick() is None  # at SERVE_MIN_REPLICAS
+        assert tele.counters.get("serve/scale_down") == 2
+        assert pool.wait_ready(1, timeout_s=10)
+    finally:
+        scaler.stop()
+        pool.close()
+
+
+def test_serving_slo_rules_shape():
+    rules = {r.name: r for r in serving_slo_rules(123.0)}
+    assert rules["serving_p99_slo"].value == 123.0
+    assert rules["serving_p99_slo"].severity == "page"
+    assert rules["serving_shed_burn"].kind == "burn_rate"
+    assert rules["reload_refused"].severity == "ticket"
+    assert rules["replica_dead"].severity == "ticket"
+
+
+# ---- the whole plane with jax import-BLOCKED ----
+
+def test_serving_plane_runs_without_jax_or_tf(tmp_path):
+    """The control plane's stdlib-only claim, enforced: pool + reload
+    + autoscaler + HTTP front-end all import and RUN in a subprocess
+    where `import jax` (and tensorflow) raises."""
+    code = textwrap.dedent("""
+        import hashlib, json, sys, urllib.request
+
+        from code2vec_tpu.common import MethodPredictionResults
+        from code2vec_tpu.config import Config
+        from code2vec_tpu.obs import Telemetry
+        from code2vec_tpu.obs.alerts import AlertRule
+        from code2vec_tpu.serving import (AutoScaler, ReloadManager,
+                                          ReplicaPool, ServingFrontend)
+
+        class FakePrepared:
+            def __init__(self, lines):
+                self.lines = list(lines)
+            @property
+            def n(self):
+                return len(self.lines)
+            def slice(self, a, b):
+                return FakePrepared(self.lines[a:b])
+            @classmethod
+            def concat(cls, parts):
+                out = []
+                for p in parts:
+                    out.extend(p.lines)
+                return cls(out)
+
+        class FakeModel:
+            def __init__(self):
+                self.params = {"tag": "v0"}
+            def warmup_predict(self, max_batch):
+                return [max_batch]
+            def predict_compile_count(self):
+                return -1
+            def prepare_predict_rows(self, lines):
+                return FakePrepared(lines)
+            def predict_device(self, prepared):
+                return (list(prepared.lines),)
+            def decode_predictions(self, chunk, result):
+                out = []
+                for ln in result[0]:
+                    r = MethodPredictionResults(ln.split(" ")[0])
+                    r.append_prediction("pred|" + self.params["tag"],
+                                        0.9)
+                    out.append(r)
+                return out
+
+        cfg = Config(SERVE_BATCH_MAX=8, SERVE_BATCH_TIMEOUT_MS=1.0,
+                     SERVE_QUEUE_DEPTH=32, SERVE_DEADLINE_MS=0.0,
+                     SERVE_CACHE_SIZE=16, SERVE_MAX_REPLICAS=3)
+        tele = Telemetry.memory("guard").make_threadsafe()
+        pool = ReplicaPool(cfg, FakeModel, replicas=2,
+                           telemetry=tele).start()
+        fe = ServingFrontend(pool, port=0, telemetry=tele).start()
+        base = f"http://127.0.0.1:{fe.bound_port}"
+
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"lines": ["m a,1,b"]}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert body["n"] == 1
+        assert body["predictions"][0]["predictions"][0]["name"] == \\
+            ["pred", "v0"]
+        for path in ("/healthz", "/metrics", "/pool"):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                assert r.status == 200
+
+        # hot reload: stdlib checksum verify + injected load_fn
+        # (construct BEFORE the step lands: steps already on disk at
+        # construction are the boot weights, not news)
+        root = sys.argv[1]
+        rm = ReloadManager(root, pool,
+                           load_fn=lambda step: {"tag": "s1"},
+                           telemetry=tele, poll_s=0.05)
+        import os
+        state = os.path.join(root, "step_1", "state")
+        os.makedirs(state)
+        blob = b"weights"
+        with open(os.path.join(state, "params.bin"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(root, "step_1", "checksums.json"),
+                  "w") as f:
+            json.dump({"step": 1, "files": {"state/params.bin": {
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "bytes": len(blob)}}}, f)
+        assert rm.check_now() == 1
+        assert pool.pool_table()["generation"] == 1
+
+        # autoscale: page rule fires -> grow
+        tele.gauge("load", 9.0, emit=False)
+        sc = AutoScaler(pool, telemetry=tele,
+                        rules=[AlertRule("hot", metric="load", op=">",
+                                         value=1.0, severity="page")],
+                        clock=lambda: 0.0)
+        assert sc.tick() == "up" and pool.target == 3
+
+        fe.stop()
+        pool.close()
+        assert "jax" not in sys.modules
+        assert "tensorflow" not in sys.modules
+        print("FRONTEND-OK")
+    """)
+    from tests.test_obs_guard import _tf_blocked_env
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-c", code, str(ckpt)],
+        env=_tf_blocked_env(tmp_path, block_jax=True), cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FRONTEND-OK" in r.stdout
+
+
+# ---- config flags ----
+
+def test_serve_flag_bounds_verify():
+    assert fake_config().SERVE_SLO_MS == 250.0  # the shipped default
+    for kw in ({"SERVE_MIN_REPLICAS": 3, "SERVE_MAX_REPLICAS": 2},
+               {"SERVE_REPLICAS": 5, "SERVE_MAX_REPLICAS": 4},
+               {"SERVE_PORT": 70000},
+               {"SERVE_SLO_MS": 0.0},
+               {"SERVE_RELOAD_POLL_S": -1.0}):
+        with pytest.raises(ValueError):
+            fake_config(**kw).verify()
+
+
+def test_serialize_prediction_shape():
+    res = MethodPredictionResults("orig")
+    res.append_prediction("do|thing", 0.75)
+    res.append_attention_path(0.25, "a", "9,8,7", "b")
+    res.code_vector = object()  # must never serialize
+    d = serialize_prediction(res)
+    assert d == {"original_name": "orig",
+                 "predictions": [{"name": ["do", "thing"],
+                                  "probability": 0.75}],
+                 "attention_paths": [{"source_token": "a",
+                                      "path": "9,8,7",
+                                      "target_token": "b",
+                                      "attention_score": 0.25}]}

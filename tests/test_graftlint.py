@@ -521,16 +521,33 @@ def test_baseline_refuses_serving_and_obs(tmp_path):
     # debt to grandfather
     bad_fleet = Finding("resource-leak",
                         "code2vec_tpu/obs/fleet.py", 1, "m", "s")
+    # ISSUE 18 satellite: the external serving plane lands inside the
+    # fenced serving/ tree — the front-end, replica pool, reload
+    # watcher and autoscaler answer live traffic, so a lock slip or a
+    # leaked thread there is a bug to fix, never debt to grandfather
+    bad_frontend = Finding("thread-handoff",
+                           "code2vec_tpu/serving/frontend.py",
+                           1, "m", "s")
+    bad_replicas = Finding("lock-discipline",
+                           "code2vec_tpu/serving/replicas.py",
+                           1, "m", "s")
+    bad_reload = Finding("resource-leak",
+                         "code2vec_tpu/serving/reload.py", 1, "m", "s")
+    bad_scaler = Finding("nondeterminism",
+                         "code2vec_tpu/serving/autoscale.py",
+                         1, "m", "s")
     ok = Finding("retrace-hazard", "tools/x.py", 1, "m", "s")
     refused = baseline_mod.write(
         [bad, bad_training, bad_ops, bad_parallel, bad_resilience,
          bad_spmd, bad_spmd_par, bad_nondet, bad_nondet_tr,
-         bad_phases, bad_probes, bad_fleet, ok],
+         bad_phases, bad_probes, bad_fleet, bad_frontend,
+         bad_replicas, bad_reload, bad_scaler, ok],
         path)
     assert refused == [bad, bad_training, bad_ops, bad_parallel,
                        bad_resilience, bad_spmd, bad_spmd_par,
                        bad_nondet, bad_nondet_tr, bad_phases,
-                       bad_probes, bad_fleet]
+                       bad_probes, bad_fleet, bad_frontend,
+                       bad_replicas, bad_reload, bad_scaler]
     assert [e["path"] for e in baseline_mod.load(path)] == ["tools/x.py"]
 
 

@@ -80,6 +80,14 @@ MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
                      "recovery_steps_lost", "recovery_seconds",
                      "host_skew_ratio")
 
+# The SERVING trajectory (tools/serving_bench.py, ISSUE 18): the
+# client-observed tail and the sustained completion rate through the
+# whole external plane (HTTP front-end -> replica pool -> batcher ->
+# device). p99 is the SLO figure — gated LOWER-is-better; req/s
+# catches an absolute throughput slide the tail could mask (queue
+# shrinks because everything sheds).
+SERVING_METRICS = ("serving_p99_ms", "serving_req_per_sec")
+
 # Metrics where SMALLER is healthier: the band becomes a ceiling
 # (baseline * (1 + band)) instead of a floor. Everything else in the
 # gate — median baseline, MAD-widened band, history windowing — is
@@ -87,7 +95,8 @@ MULTICHIP_METRICS = ("scaling_efficiency", "multi_pc_per_sec",
 # _lower_is_better (per-phase device times are costs, not throughput).
 LOWER_IS_BETTER = frozenset({"recovery_steps_lost",
                              "recovery_seconds",
-                             "host_skew_ratio"})
+                             "host_skew_ratio",
+                             "serving_p99_ms"})
 
 
 def _lower_is_better(metric: str) -> bool:
@@ -97,6 +106,7 @@ def _lower_is_better(metric: str) -> bool:
 KINDS = {
     "bench": ("BENCH_r*.json", DEFAULT_METRICS),
     "multichip": ("MULTICHIP_r*.json", MULTICHIP_METRICS),
+    "serving": ("SERVING_r*.json", SERVING_METRICS),
 }
 
 
@@ -128,8 +138,8 @@ def load_rounds(dir_path: str, pattern: str = "BENCH_r*.json"
         result = obj.get("parsed") if isinstance(obj, dict) else None
         if result is None and isinstance(obj, dict) \
                 and ("value" in obj
-                     or obj.get("schema") == "multichip"):
-            result = obj  # bench.py / multichip_bench.py bare object
+                     or obj.get("schema") in ("multichip", "serving")):
+            result = obj  # bench/multichip/serving bare round object
         if not isinstance(result, dict):
             print(f"warning: {path} carries no parsed bench result; "
                   "skipped", file=sys.stderr)
@@ -279,7 +289,9 @@ def main(argv=None) -> int:
                     help="which round trajectory to gate: 'bench' = "
                          "BENCH_r*.json single-chip rounds, "
                          "'multichip' = MULTICHIP_r*.json "
-                         "scaling-efficiency rounds")
+                         "scaling-efficiency rounds, 'serving' = "
+                         "SERVING_r*.json external-plane rounds "
+                         "(p99 ceiling + req/s floor)")
     ap.add_argument("--metrics", nargs="+", default=None,
                     help="result keys to gate (higher is better); "
                          "default: the --kind's gated set")

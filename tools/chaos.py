@@ -24,6 +24,14 @@ failpoint, and asserts the recovery contract:
                      detects it, QUARANTINES the step dir, emits an
                      `alert` event through the alert engine, and the
                      run resumes from the prior committed step.
+  serve_swap_kill    The serving-plane acceptance (ISSUE 18): under
+                     open-loop Poisson load against a replica pool, a
+                     replica dies mid-request (`serve/kill`, action
+                     raise), a VERIFIED committed checkpoint hot-swaps
+                     in one replica at a time, and a bit-flipped step
+                     is REFUSED (ticket alert) — while p99 holds the
+                     SLO, zero requests are lost, and zero new jit
+                     compilations happen under load.
   kill_resize        The elastic-resume parity bar (ISSUE 13): SIGKILL
                      one peer of a 2-process cohort mid-epoch; the
                      supervisor (resize_policy=shrink) RE-FORMS the
@@ -583,11 +591,170 @@ def scenario_corrupt_checkpoint(out: str) -> dict:
     return result
 
 
+def scenario_serve_swap_kill(out: str, *, replicas: int = 2,
+                             requests: int = 768, qps: float = 120.0,
+                             kill_at: int = 40) -> dict:
+    """The serving-plane acceptance (ISSUE 18): a replica pool under
+    open-loop Poisson load with hot-key skew takes a mid-request
+    replica death (`serve/kill`), a rolling hot swap of a VERIFIED
+    committed checkpoint, and a REFUSED bit-flipped step — and the
+    external contract holds: p99 under the SLO, zero requests lost
+    (sheds are explicit), zero new jit compilations under load, pool
+    back to full strength."""
+    import threading
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data import preprocess as preprocess_mod
+    from code2vec_tpu.models.jax_model import Code2VecModel
+    from code2vec_tpu.obs import Telemetry
+    from code2vec_tpu.obs.alerts import AlertEngine, serving_slo_rules
+    from code2vec_tpu.resilience import faults
+    from code2vec_tpu.serving import ReloadManager, ReplicaPool
+    from code2vec_tpu.training import checkpoint as ckpt
+    from tools import loadgen
+
+    t0 = time.time()
+    # the loadgen tiny-model recipe: latency is shape-dependent, not
+    # value-dependent, so random weights over tiny vocabs serve fine
+    data_dir = os.path.join(out, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    raw = os.path.join(data_dir, "raw.txt")
+    with open(raw, "w", encoding="utf-8") as f:
+        f.write("\n".join(ln for req in loadgen.gen_corpus(64, 2, seed=7)
+                          for ln in req) + "\n")
+    prefix = os.path.join(data_dir, "tiny")
+    preprocess_mod.main([
+        "--train_data", raw, "--val_data", raw, "--test_data", raw,
+        "--max_contexts", "16", "--word_vocab_size", "1000",
+        "--path_vocab_size", "1000", "--target_vocab_size", "1000",
+        "--output_name", prefix])
+    cfg = Config(MAX_CONTEXTS=16, MAX_TOKEN_VOCAB_SIZE=1000,
+                 MAX_PATH_VOCAB_SIZE=1000, MAX_TARGET_VOCAB_SIZE=1000,
+                 DEFAULT_EMBEDDINGS_SIZE=16, USE_BF16=False)
+    cfg.train_data_path = prefix
+    cfg.SERVE_REPLICAS = replicas
+    cfg.SERVE_MAX_REPLICAS = max(replicas, cfg.SERVE_MAX_REPLICAS)
+
+    # one in-band kill: the kill_at-th predict_lines call raises
+    # FaultInjected inside whichever replica serves it (action "kill"
+    # would SIGKILL this whole process) — the pool must retry the
+    # request on a survivor and refill in the background
+    faults.install({"seed": 0, "sites": {
+        "serve/kill": {"action": "raise", "at": kill_at}}},
+        log=lambda m: print(f"[chaos] {m}", flush=True))
+
+    tele = Telemetry.memory("chaos-serving").make_threadsafe()
+    pool = ReplicaPool(cfg, lambda: Code2VecModel(cfg),
+                       replicas=replicas, telemetry=tele).start()
+    alerts = AlertEngine.create(
+        tele, mode="warn", rules=serving_slo_rules(cfg.SERVE_SLO_MS))
+    reload_dir = os.path.join(out, "serve_ckpt")
+    rm = ReloadManager(reload_dir, pool, telemetry=tele, alerts=alerts,
+                       poll_s=0.1).start()
+
+    progress = {}
+
+    def _chaos_actions() -> None:
+        import jax
+        # vocabs/dims for the sidecars come from a live replica; the
+        # swapped-in params are a real value change (same shapes, so
+        # the swap must not recompile anything)
+        model = pool._replicas[0].server.model
+        new_params = jax.tree_util.tree_map(
+            lambda x: (x * 1.001).astype(x.dtype),
+            pool.params_template())
+        time.sleep(0.5)  # let the load establish itself first
+        ckpt.save_checkpoint(reload_dir, {"params": new_params}, 1,
+                             model.vocabs, model.dims)
+        deadline = time.time() + 60
+        while rm.last_step < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        if rm.last_step >= 1:
+            progress["swap_ts"] = time.time()
+        ckpt.save_checkpoint(reload_dir, {"params": new_params}, 2,
+                             model.vocabs, model.dims)
+        _flip_byte_in_largest_blob(os.path.join(reload_dir, "step_2"))
+        deadline = time.time() + 60
+        while 2 not in rm.refused and time.time() < deadline:
+            time.sleep(0.05)
+        if 2 in rm.refused:
+            progress["refused_ts"] = time.time()
+
+    actions = threading.Thread(target=_chaos_actions,
+                               name="chaos-actions", daemon=True)
+    corpus = loadgen.gen_corpus(requests, 1,
+                                max_ctx=min(cfg.MAX_CONTEXTS, 12))
+    try:
+        actions.start()
+        report = loadgen.run_load(
+            pool, corpus, mode="open", concurrency=16, qps=qps,
+            arrivals="poisson", hot_key_frac=0.25, hot_keys=8, seed=0)
+        t_load_end = time.time()
+        actions.join(timeout=120)
+        # the refill may still be warming when the load drains; it
+        # must land (back to full strength) before the verdict
+        pool.wait_ready(replicas, timeout_s=120)
+        compile_delta = pool.compile_delta()
+        table = pool.pool_table()
+        counters = dict(tele.counters)
+        fired = faults.stats().get("serve/kill", {}).get("fired", 0)
+        refused_state = next(
+            (r["state"] for r in alerts.status_table()
+             if r["rule"] == "reload_refused"), None)
+    finally:
+        rm.stop()
+        pool.close()
+        faults.clear()
+
+    result = {
+        "scenario": "serve_swap_kill",
+        "requests": report["requests"],
+        "ok_requests": report["ok"],
+        "shed": report["shed"],
+        "errors": report["errors"],
+        "p50_ms": report["latency"]["p50_ms"],
+        "p99_ms": report["latency"]["p99_ms"],
+        "slo_ms": cfg.SERVE_SLO_MS,
+        "throughput_rps": report["throughput_rps"],
+        "kill_fired": fired == 1,
+        "replica_dead": counters.get("serve/replica_dead", 0),
+        "replica_refill": counters.get("serve/replica_refill", 0),
+        "reloads": counters.get("serve/reloads", 0),
+        "reload_refused": counters.get("serve/reload_refused", 0),
+        "swapped_step": rm.last_step,
+        "refused_steps": sorted(rm.refused),
+        "swap_under_load": ("swap_ts" in progress
+                            and progress["swap_ts"] <= t_load_end),
+        "refused_alert_state": refused_state,
+        "pool_generation": table["generation"],
+        "pool_ready": table["ready"],
+        "new_compilations_under_load": compile_delta,
+        "cache_hits": counters.get("serve/cache_hit", 0),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    result["ok"] = (
+        report["errors"] == 0
+        and report["requests"] == report["ok"] + report["shed"]
+        and report["latency"]["p99_ms"] <= cfg.SERVE_SLO_MS
+        and result["kill_fired"]
+        and result["replica_dead"] == 1
+        and result["replica_refill"] == 1
+        and result["swapped_step"] == 1
+        and table["generation"] == 1
+        and result["refused_steps"] == [2]
+        and result["swap_under_load"]
+        and refused_state == "firing"
+        and compile_delta == 0
+        and table["ready"] >= replicas)
+    return result
+
+
 SCENARIOS = {
     "kill_resume": scenario_kill_resume,
     "kill_resume_2proc": scenario_kill_resume_2proc,
     "kill_resize": scenario_kill_resize,
     "corrupt_checkpoint": scenario_corrupt_checkpoint,
+    "serve_swap_kill": scenario_serve_swap_kill,
 }
 
 

@@ -111,11 +111,49 @@ def run_sequential(model, corpus: List[List[str]],
             "latency": _percentiles(tele.timer("loadgen/request_ms"))}
 
 
+def _modulation_fn(modulation: Optional[str], period_s: float):
+    """Offered-load multiplier over elapsed time (ISSUE 18: the open
+    loop as a traffic MODEL, not a metronome):
+
+      - None      — flat 1.0 (the PR-3 behavior);
+      - "diurnal" — a smooth day-cycle compressed to `period_s`:
+                    1 + 0.5*sin(2*pi*t/period), floored at 0.05 so the
+                    trough still trickles;
+      - "bursty"  — a 3x spike for the first 10% of each period, 0.8x
+                    the rest: the flash-crowd shape autoscaling and
+                    admission control have to absorb.
+    """
+    if modulation is None or modulation == "none":
+        return lambda _t: 1.0
+    if modulation == "diurnal":
+        import math
+        return lambda t: max(
+            0.05, 1.0 + 0.5 * math.sin(2 * math.pi * t / period_s))
+    if modulation == "bursty":
+        return lambda t: 3.0 if (t % period_s) < 0.1 * period_s else 0.8
+    raise ValueError(f"unknown modulation {modulation!r}")
+
+
 def run_load(server, corpus: List[List[str]], mode: str = "closed",
              concurrency: int = 8, qps: float = 100.0,
-             duration: Optional[float] = None) -> Dict:
+             duration: Optional[float] = None,
+             arrivals: str = "fixed",
+             modulation: Optional[str] = None,
+             modulation_period_s: float = 60.0,
+             hot_key_frac: float = 0.0, hot_keys: int = 8,
+             seed: int = 0) -> Dict:
     """Drive `server.predict_lines` with the chosen arrival process.
-    The server must be started (buckets warmed) by the caller."""
+    The server must be started (buckets warmed) by the caller.
+
+    Open-loop extras (ISSUE 18): `arrivals="poisson"` draws
+    exponential inter-arrival gaps (the memoryless process real
+    traffic approximates — fixed intervals can phase-lock with the
+    batcher window and hide tail latency); `modulation` shapes the
+    instantaneous rate (see `_modulation_fn`); `hot_key_frac` sends
+    that fraction of arrivals to the first `hot_keys` corpus entries
+    (Zipf-style skew — what makes the shared prediction cache earn
+    its keep under replica fan-out). All draws come from one seeded
+    stream, so a capture is replayable."""
     from code2vec_tpu.serving.batcher import ServerOverloaded
 
     tele = server.telemetry
@@ -162,20 +200,36 @@ def run_load(server, corpus: List[List[str]], mode: str = "closed",
             t.join()
     elif mode == "open":
         import concurrent.futures
-        interval = 1.0 / max(qps, 1e-9)
+        if arrivals not in ("fixed", "poisson"):
+            raise ValueError(f"unknown arrivals {arrivals!r}")
+        rng = random.Random(seed)
+        mod_fn = _modulation_fn(modulation, modulation_period_s)
+        n_hot = max(1, min(hot_keys, len(corpus)))
         n = len(corpus) if duration is None else (1 << 30)
+        next_arrival = t_start
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=concurrency) as pool:
             futures = []
             for i in range(n):
                 if _expired():
                     break
-                futures.append(pool.submit(one, i))
+                idx = i
+                if hot_key_frac > 0 and rng.random() < hot_key_frac:
+                    # skewed traffic: this arrival re-asks one of the
+                    # hot keys instead of walking the corpus
+                    idx = rng.randrange(n_hot)
+                futures.append(pool.submit(one, idx))
                 if len(futures) >= 4096:
                     # long-run soak mode: reap finished futures so the
                     # list stays bounded over hours of offered load
                     futures = [f for f in futures if not f.done()]
-                next_arrival = t_start + (i + 1) * interval
+                # instantaneous rate at THIS arrival; the gap to the
+                # next one is 1/rate (fixed) or an exponential draw
+                # with that mean (poisson)
+                rate = max(1e-9, qps * mod_fn(next_arrival - t_start))
+                gap = (rng.expovariate(rate) if arrivals == "poisson"
+                       else 1.0 / rate)
+                next_arrival += gap
                 sleep = next_arrival - time.perf_counter()
                 if sleep > 0:
                     time.sleep(sleep)
@@ -198,6 +252,13 @@ def run_load(server, corpus: List[List[str]], mode: str = "closed",
         report["first_error"] = state["first_error"]
     if mode == "open":
         report["offered_qps"] = qps
+        report["arrivals"] = arrivals
+        report["modulation"] = modulation or "none"
+        if modulation:
+            report["modulation_period_s"] = modulation_period_s
+        if hot_key_frac > 0:
+            report["hot_key_frac"] = hot_key_frac
+            report["hot_keys"] = hot_keys
     return report
 
 
@@ -252,6 +313,26 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop offered load")
+    ap.add_argument("--arrivals", default="fixed",
+                    choices=["fixed", "poisson"],
+                    help="open-loop arrival process: fixed intervals "
+                         "or Poisson (exponential gaps)")
+    ap.add_argument("--modulation", default="none",
+                    choices=["none", "diurnal", "bursty"],
+                    help="open-loop rate shaping: a compressed "
+                         "day-cycle sine or a 3x flash-crowd burst "
+                         "per period")
+    ap.add_argument("--modulation_period_s", type=float, default=60.0,
+                    help="one diurnal/bursty cycle length in seconds")
+    ap.add_argument("--hot_key_frac", type=float, default=0.0,
+                    help="fraction of open-loop arrivals redirected "
+                         "to the --hot_keys hottest corpus entries "
+                         "(cache-skew traffic)")
+    ap.add_argument("--hot_keys", type=int, default=8,
+                    help="size of the hot-key set")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="arrival/hot-key draw seed (replayable "
+                         "captures)")
     ap.add_argument("--duration", type=float, default=None,
                     help="long-run mode: loop the corpus for S seconds")
     ap.add_argument("--serve_batch_max", type=int, default=None)
@@ -340,7 +421,13 @@ def main(argv=None) -> int:
         mode = "closed" if args.mode == "compare" else args.mode
         rep = run_load(server, corpus, mode=mode,
                        concurrency=args.concurrency, qps=args.qps,
-                       duration=args.duration)
+                       duration=args.duration,
+                       arrivals=args.arrivals,
+                       modulation=(None if args.modulation == "none"
+                                   else args.modulation),
+                       modulation_period_s=args.modulation_period_s,
+                       hot_key_frac=args.hot_key_frac,
+                       hot_keys=args.hot_keys, seed=args.seed)
         if compiled_after_warmup >= 0:
             rep["compiled_variants_after_warmup"] = compiled_after_warmup
             rep["new_compilations_under_load"] = (
